@@ -1,0 +1,36 @@
+"""Mixed-precision core (reference: ``apex/amp``).
+
+Entry points:
+  - ``initialize(...)``     — opt-level driven setup (frontend.py:258 analog)
+  - ``scale_loss(...)``     — loss-scaling context / functional helpers
+  - ``autocast(dtype)``     — scoped per-op cast insertion (O1/O4)
+  - ``LossScaler`` / pure ``scaler`` module — dynamic loss scaling as pytree state
+  - registries/decorators   — half/bfloat16/float/promote function registration
+"""
+
+from . import scaler
+from .scaler import LossScaler, ScalerState
+from .properties import Properties, opt_levels
+from .amp import (
+    init,
+    uninit,
+    is_initialized,
+    autocast,
+    disable_casts,
+    half_function,
+    bfloat16_function,
+    float_function,
+    promote_function,
+    register_half_function,
+    register_bfloat16_function,
+    register_float_function,
+    register_promote_function,
+)
+from .frontend import (
+    initialize,
+    scale_loss,
+    state_dict,
+    load_state_dict,
+    AmpState,
+    master_params,
+)

@@ -1,0 +1,189 @@
+"""The autocast patcher: O1/O4's per-op cast insertion for JAX.
+
+Re-design of ``apex/amp/amp.py`` (``init()`` :75-198, decorators :29-44, user
+registries :48-71).  The reference monkey-patches ``torch`` / ``torch.Tensor``
+/ ``F``; here we patch ``jax.numpy`` / ``jax.lax`` / ``jax.nn`` attributes.
+Because ``jax.jit`` *traces Python*, a patched ``jnp.matmul`` inserts its casts
+directly into the traced computation — the same effect the reference achieves
+at eager-op granularity, but the casts then fuse away under XLA.
+
+Patching is process-global and reversible (``uninit``/``autocast`` context),
+which the reference could not do; tests rely on that.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import itertools
+
+import jax
+import jax.numpy as jnp
+
+from . import wrap
+from .lists import jnp_overrides as L
+
+# --- user registries (amp.py:48-71) ----------------------------------------
+
+_USER_REGISTRY = {"low_prec": set(), "fp32": set(), "promote": set()}
+_user_cast_entries = []   # (module, name, category)
+
+
+def register_half_function(module, name):
+    _user_cast_entries.append((module, name, "low_prec"))
+
+
+# bf16 and fp16 share the "low precision" category; which dtype applies is
+# chosen at init() time by patch_type (amp.py:33-35, maybe_bfloat16).
+register_bfloat16_function = register_half_function
+
+
+def register_float_function(module, name):
+    _user_cast_entries.append((module, name, "fp32"))
+
+
+def register_promote_function(module, name):
+    _user_cast_entries.append((module, name, "promote"))
+
+
+# --- decorators (amp.py:29-44) ----------------------------------------------
+
+def half_function(fn):
+    """Run ``fn`` with inputs cast to the active low-precision type whenever
+    autocast is on (identity otherwise)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _state["patch_type"] is not None:
+            c = wrap.make_cast_wrapper(fn, _state["patch_type"])
+            return c(*args, **kwargs)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+bfloat16_function = half_function
+
+
+def float_function(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _state["patch_type"] is not None:
+            c = wrap.make_cast_wrapper(fn, jnp.float32)
+            return c(*args, **kwargs)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def promote_function(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _state["patch_type"] is not None:
+            return wrap.make_promote_wrapper(fn)(*args, **kwargs)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+# --- patch machinery ---------------------------------------------------------
+
+_state = {"patch_type": None, "saved": []}
+
+
+def _patch(module, name, wrapper_factory, *factory_args):
+    if not hasattr(module, name):
+        return
+    orig = getattr(module, name)
+    if hasattr(orig, "__amp_orig__"):  # already patched
+        return
+    _state["saved"].append((module, name, orig))
+    setattr(module, name, wrapper_factory(orig, *factory_args))
+
+
+def init(patch_type=jnp.float16, enable_casts=True, allow_banned=False):
+    """Install autocast patches (amp.py:75-198).  ``patch_type`` selects fp16
+    (O1) vs bf16 (O4) — on TPU prefer bf16; fp16 is supported for parity."""
+    if not enable_casts:
+        return
+    if _state["patch_type"] is not None:
+        if jnp.dtype(_state["patch_type"]) == jnp.dtype(patch_type):
+            return
+        uninit()
+    patch_type = jnp.dtype(patch_type)
+    _state["patch_type"] = patch_type
+
+    low_jnp = L.JNP_LOW_PREC if patch_type == jnp.float16 else L.JNP_LOW_PREC_BF16
+    low_lax = L.LAX_LOW_PREC if patch_type == jnp.float16 else L.LAX_LOW_PREC_BF16
+    for name in low_jnp:
+        _patch(jnp, name, wrap.make_cast_wrapper, patch_type)
+    for name in low_lax:
+        _patch(jax.lax, name, wrap.make_cast_wrapper, patch_type)
+    for name in L.NN_LOW_PREC:
+        _patch(jax.nn, name, wrap.make_cast_wrapper, patch_type)
+
+    for name in L.JNP_FP32:
+        _patch(jnp, name, wrap.make_cast_wrapper, jnp.float32)
+    for name in L.LAX_FP32:
+        _patch(jax.lax, name, wrap.make_cast_wrapper, jnp.float32)
+    for name in L.NN_FP32:
+        _patch(jax.nn, name, wrap.make_cast_wrapper, jnp.float32)
+    for name in L.LINALG_FP32:
+        _patch(jnp.linalg, name, wrap.make_cast_wrapper, jnp.float32)
+
+    for name in L.JNP_CASTS:
+        _patch(jnp, name, wrap.make_promote_wrapper)
+    for name in L.JNP_SEQUENCE_CASTS:
+        _patch(jnp, name, wrap.make_sequence_promote_wrapper)
+
+    if not allow_banned:
+        for mod, name, msg in L.BANNED_FUNCS:
+            _patch(mod, name, wrap.make_banned_wrapper, name, msg)
+
+    for module, name, category in _user_cast_entries:
+        if category == "low_prec":
+            _patch(module, name, wrap.make_cast_wrapper, patch_type)
+        elif category == "fp32":
+            _patch(module, name, wrap.make_cast_wrapper, jnp.float32)
+        else:
+            _patch(module, name, wrap.make_promote_wrapper)
+
+
+def uninit():
+    """Remove all patches (no reference analog; needed for test isolation and
+    the autocast() scoped context)."""
+    for module, name, orig in reversed(_state["saved"]):
+        setattr(module, name, orig)
+    _state["saved"].clear()
+    _state["patch_type"] = None
+
+
+def is_initialized():
+    return _state["patch_type"] is not None
+
+
+@contextlib.contextmanager
+def autocast(dtype=jnp.bfloat16):
+    """Scoped autocast — the ergonomic TPU-native entry point.
+
+    NOTE: patches are process-global while active; a function *traced* inside
+    this context keeps its casts forever (they are baked into the jaxpr), which
+    is exactly the semantic torch autocast has per-op at eager time.
+    """
+    was = _state["patch_type"]
+    init(patch_type=dtype)
+    try:
+        yield
+    finally:
+        uninit()
+        if was is not None:
+            init(patch_type=was)
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Temporarily disable patches (``handle.disable_casts``, handle.py:163-167)
+    — used around optimizer steps so master-weight math stays fp32."""
+    saved = list(_state["saved"])
+    ptype = _state["patch_type"]
+    uninit()
+    try:
+        yield
+    finally:
+        if ptype is not None:
+            init(patch_type=ptype)

@@ -1,0 +1,191 @@
+"""amp frontend: ``initialize`` / ``scale_loss`` / state (de)serialization.
+
+Re-design of ``apex/amp/frontend.py:258-467`` + ``_initialize.py:145-265`` for
+a functional world.  The reference mutates models/optimizers in place; here
+``initialize`` takes the model's param pytree (and optionally an apex_tpu
+fused optimizer) and returns an ``AmpState`` bundle of pure pieces:
+
+    amp_state = amp.initialize(params, optimizer, opt_level="O5", num_losses=1)
+    amp_state.model_params      # params cast per opt level (bf16/fp16/fp32)
+    amp_state.master_params     # fp32 masters (O2/O5) or None
+    amp_state.scalers           # tuple[ScalerState], one per loss_id
+    amp_state.cast_input(x)     # input-cast helper (patched-forward analog)
+
+plus pure step helpers (``amp_step``) that implement the full
+scale → grad → unscale → check → (skip-)update → rescale pipeline of
+``handle.scale_loss`` (handle.py:16-158) + ``_process_optimizer`` as one
+jittable function.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import amp as _amp
+from . import scaler as _scaler
+from .properties import Properties, opt_levels
+from ..utils import pytree as _pt
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class AmpState:
+    """The bundle returned by initialize().  ``properties`` and ``optimizer``
+    are static pytree metadata (trace constants); params/scalers/opt_state are
+    traced leaves, so an AmpState threads directly through jit."""
+    model_params: Any               # cast params
+    master_params: Any              # fp32 masters or None
+    scalers: Tuple[_scaler.ScalerState, ...]
+    opt_state: Any                  # optimizer state or None
+    properties: Any = dataclasses.field(metadata=dict(static=True), default=None)
+    optimizer: Any = dataclasses.field(metadata=dict(static=True), default=None)
+
+    def _replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+    # -- convenience ---------------------------------------------------------
+    @property
+    def loss_scale(self):
+        return self.scalers[0].loss_scale
+
+    def cast_input(self, x):
+        dt = self.properties.cast_model_type
+        if dt in (None, False):
+            return x
+        args, _ = _pt.cast_inputs((x,), {}, dt)
+        return args[0]
+
+    def params_for_eval(self):
+        """fp32 view of params (the O2 state_dict hook, _initialize.py:133-142)."""
+        src = self.master_params if self.master_params is not None else self.model_params
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, src)
+
+
+def initialize(params, optimizer=None, opt_level="O1", *,
+               num_losses=1, verbosity=1,
+               cast_model_type=None, patch_functions=None,
+               keep_batchnorm_fp32=None, master_weights=None,
+               loss_scale=None, min_loss_scale=1.0,
+               max_loss_scale=2.0 ** 24) -> AmpState:
+    """Opt-level driven setup (``frontend.py:258-425``).
+
+    params: fp32 model param pytree.  optimizer: an apex_tpu fused optimizer
+    (algorithm object) — its state is created against the *master* params.
+    Overrides after the preset mirror the reference's kwarg override flow
+    (frontend.py:401-419).
+    """
+    if opt_level not in opt_levels:
+        raise RuntimeError(f"Unexpected optimization level {opt_level}; "
+                           "options are 'O0'..'O5'.")
+    props = opt_levels[opt_level](Properties())
+    for name, val in (("cast_model_type", cast_model_type),
+                      ("patch_functions", patch_functions),
+                      ("keep_batchnorm_fp32", keep_batchnorm_fp32),
+                      ("master_weights", master_weights),
+                      ("loss_scale", loss_scale)):
+        if val is not None:
+            setattr(props, name, val)
+    if verbosity:
+        print(f"apex_tpu.amp: opt_level {opt_level} -> {props}")
+
+    # model cast (O2/O3/O5 path; _initialize.py:176-182)
+    model_params = params
+    ct = props.cast_model_type
+    if ct not in (None, False) and jnp.dtype(ct) != jnp.float32:
+        model_params = _pt.convert_network(
+            params, ct, keep_batchnorm_fp32=bool(props.keep_batchnorm_fp32))
+    elif ct not in (None, False):
+        model_params = _pt.cast_tree(params, jnp.float32)
+
+    # master weights (_process_optimizer.py:28-90)
+    masters = _pt.master_params_from(params) if props.master_weights else None
+
+    # per-loss scalers (_initialize.py:227-231)
+    scalers = tuple(
+        _scaler.init(props.loss_scale, min_loss_scale=min_loss_scale,
+                     max_loss_scale=max_loss_scale)
+        for _ in range(num_losses))
+
+    # O1/O4: install per-op autocast patches (amp.py:75)
+    if props.patch_functions and props.patch_functions_type is not None:
+        _amp.init(patch_type=props.patch_functions_type)
+
+    opt_state = None
+    if optimizer is not None:
+        target = masters if masters is not None else model_params
+        opt_state = optimizer.init(target)
+
+    return AmpState(model_params=model_params, master_params=masters,
+                    scalers=scalers, opt_state=opt_state, properties=props,
+                    optimizer=optimizer)
+
+
+def scale_loss(loss, amp_state: AmpState, loss_id: int = 0):
+    """Functional ``amp.scale_loss`` (handle.py:16): loss * current scale."""
+    return _scaler.scale_loss(amp_state.scalers[loss_id], loss)
+
+
+def amp_step(amp_state: AmpState, grads, *, loss_id: int = 0, lr=None):
+    """The full post-backward pipeline as one pure function:
+
+    unscale grads → overflow check → fused optimizer step on masters →
+    skip-step select on overflow → scaler update → model-precision copies.
+    Mirrors ``_post_amp_backward`` + patched ``step``
+    (_process_optimizer.py:142-202,354-369, handle.py:121-154) with the
+    control flow expressed as data (lax/where) so it jits.
+    Returns a new AmpState.
+    """
+    if amp_state.optimizer is None:
+        raise RuntimeError("amp_step requires an optimizer passed to initialize()")
+    sc = amp_state.scalers[loss_id]
+    grads32, finite = _scaler.unscale(sc, grads)
+
+    masters = (amp_state.master_params if amp_state.master_params is not None
+               else amp_state.model_params)
+    new_masters, new_opt_state = amp_state.optimizer.step(
+        amp_state.opt_state, grads32, masters, lr=lr)
+
+    # overflow => keep old params AND old optimizer state
+    new_masters = _scaler.apply_if_finite(finite, new_masters, masters)
+    new_opt_state = _scaler.apply_if_finite(finite, new_opt_state,
+                                            amp_state.opt_state)
+    new_sc = _scaler.update(sc, finite)
+    scalers = tuple(new_sc if i == loss_id else s
+                    for i, s in enumerate(amp_state.scalers))
+
+    if amp_state.master_params is not None:
+        model_params = _pt.master_to_model(new_masters, amp_state.model_params)
+        return amp_state._replace(model_params=model_params,
+                                  master_params=new_masters,
+                                  scalers=scalers, opt_state=new_opt_state)
+    return amp_state._replace(model_params=new_masters, scalers=scalers,
+                              opt_state=new_opt_state)
+
+
+def master_params(amp_state: AmpState):
+    """Iterate master (fp32) params — ``amp.master_params`` (_amp_state.py:58-68)."""
+    src = (amp_state.master_params if amp_state.master_params is not None
+           else amp_state.model_params)
+    return jax.tree_util.tree_leaves(src)
+
+
+def state_dict(amp_state: AmpState) -> dict:
+    """Serialize all scaler states (``amp.state_dict``, frontend.py:428-442)."""
+    return {f"loss_scaler{i}": _scaler.state_dict(s)
+            for i, s in enumerate(amp_state.scalers)}
+
+
+def load_state_dict(amp_state: AmpState, d: dict) -> AmpState:
+    """Restore scaler states (frontend.py:444-467)."""
+    if len(d) != len(amp_state.scalers):
+        print(f"Warning: loading state with {len(d)} scalers into "
+              f"{len(amp_state.scalers)} (frontend.py:449 semantics)")
+    scalers = list(amp_state.scalers)
+    for i in range(min(len(d), len(scalers))):
+        scalers[i] = _scaler.load_state_dict(d[f"loss_scaler{i}"])
+    return amp_state._replace(scalers=tuple(scalers))

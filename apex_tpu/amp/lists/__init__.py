@@ -1,0 +1,2 @@
+"""Op-classification lists for autocast (reference: ``apex/amp/lists``)."""
+from . import jnp_overrides

@@ -1,0 +1,182 @@
+"""Dynamic / static loss scaling as pure, jit-able pytree state.
+
+TPU-native re-design of the reference ``apex/amp/scaler.py`` (LossScaler,
+``scaler.py:42-226``).  The reference mutates Python attributes and does one
+intentional host sync per step (``_overflow_buf.item()``, ``scaler.py:209``);
+under XLA the whole thing must be traceable, so the scaler is a NamedTuple
+carried through the jitted train step and the "skip step on overflow" decision
+becomes a ``jnp.where``/``lax.cond`` over the update pytree — zero host syncs.
+
+Scale-update policy matches ``scaler.py:206-226``: x2 after ``scale_window``
+(default 2000) consecutive finite steps, /2 on overflow, clamped to
+[min_loss_scale, max_loss_scale] (default max 2**24).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ScalerState:
+    """Pure state for one loss scaler (one per ``loss_id`` as in handle.py).
+    The policy knobs are static pytree metadata so they never trace."""
+    loss_scale: jnp.ndarray        # f32 scalar
+    unskipped: jnp.ndarray         # i32 scalar: consecutive finite steps
+    dynamic: bool = dataclasses.field(metadata=dict(static=True), default=True)
+    scale_window: int = dataclasses.field(metadata=dict(static=True), default=2000)
+    min_loss_scale: float = dataclasses.field(metadata=dict(static=True), default=1.0)
+    max_loss_scale: float = dataclasses.field(metadata=dict(static=True), default=2.0 ** 24)
+
+    @property
+    def scale(self):
+        return self.loss_scale
+
+    def _replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+def init(loss_scale="dynamic", init_scale=2.0 ** 16, scale_window=2000,
+         min_loss_scale=1.0, max_loss_scale=2.0 ** 24) -> ScalerState:
+    """Create scaler state.  ``loss_scale`` follows the reference convention:
+    the string "dynamic" or a static float (frontend.py loss_scale property)."""
+    dynamic = loss_scale == "dynamic"
+    scale0 = init_scale if dynamic else float(loss_scale)
+    return ScalerState(
+        loss_scale=jnp.asarray(scale0, jnp.float32),
+        unskipped=jnp.zeros((), jnp.int32),
+        dynamic=dynamic,
+        scale_window=int(scale_window),
+        min_loss_scale=float(min_loss_scale),
+        max_loss_scale=float(max_loss_scale),
+    )
+
+
+def scale_loss(state: ScalerState, loss):
+    """``with amp.scale_loss(loss, opt) as scaled_loss`` analog (handle.py:16-113):
+    returns loss * scale in fp32."""
+    return jnp.asarray(loss, jnp.float32) * state.loss_scale
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """Fused overflow check over a grad pytree — the reference's
+    ``_overflow_buf`` populated by multi_tensor kernels (scaler.py:103-128).
+    XLA fuses the per-leaf reductions into the surrounding graph."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+    return jnp.stack(finite).all()
+
+
+def unscale(state: ScalerState, grads, *, check_finite=True):
+    """Unscale grads to fp32 masters and report finiteness.
+
+    Mirrors ``LossScaler.unscale`` (scaler.py:103-128): out = grads * (1/scale)
+    with the inf/nan check fused in.  Returns ``(unscaled_grads, finite)``.
+    """
+    inv = 1.0 / state.loss_scale
+    unscaled = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv).astype(jnp.float32), grads)
+    finite = all_finite(grads) if check_finite else jnp.asarray(True)
+    return unscaled, finite
+
+
+def unscale_with_stashed(state: ScalerState, new_grads, stashed_grads):
+    """Gradient-accumulation path (``unscale_with_stashed``, scaler.py:161-193):
+    out = stashed + (1/scale) * new, fused axpby."""
+    inv = 1.0 / state.loss_scale
+    out = jax.tree_util.tree_map(
+        lambda n, s: s.astype(jnp.float32) + n.astype(jnp.float32) * inv,
+        new_grads, stashed_grads)
+    finite = all_finite(new_grads)
+    return out, finite
+
+
+def update(state: ScalerState, finite) -> ScalerState:
+    """Scale-update policy of ``LossScaler.update_scale`` (scaler.py:206-226),
+    expressed branch-free so it jits."""
+    if not state.dynamic:
+        return state
+    finite = jnp.asarray(finite)
+    # on overflow: halve (clamped below); on success: count up, double at window
+    halved = jnp.maximum(state.loss_scale / 2.0, state.min_loss_scale)
+    grown_count = state.unskipped + 1
+    should_grow = grown_count >= state.scale_window
+    grown = jnp.where(
+        should_grow,
+        jnp.minimum(state.loss_scale * 2.0, state.max_loss_scale),
+        state.loss_scale)
+    new_scale = jnp.where(finite, grown, halved)
+    new_unskipped = jnp.where(finite & ~should_grow, grown_count, 0)
+    return state._replace(loss_scale=new_scale, unskipped=new_unskipped)
+
+
+def apply_if_finite(finite, new_tree, old_tree):
+    """Skip-step: select the updated pytree only when grads were finite.
+
+    Replaces the reference's runtime patching of ``optimizer.step`` into a
+    no-op on overflow (handle.py:127-154) with a data-parallel select, which
+    is how a traced TPU program must express it."""
+    finite = jnp.asarray(finite)
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(finite, n, o.astype(n.dtype)), new_tree, old_tree)
+
+
+# --- (de)serialization: amp.state_dict()/load_state_dict analog -------------
+
+def state_dict(state: ScalerState) -> dict:
+    """Serialize per-scaler state like ``amp.state_dict`` (frontend.py:428-467)."""
+    return {
+        "loss_scale": float(state.loss_scale),
+        "unskipped": int(state.unskipped),
+        "dynamic": state.dynamic,
+        "scale_window": state.scale_window,
+        "min_loss_scale": state.min_loss_scale,
+        "max_loss_scale": state.max_loss_scale,
+    }
+
+
+def load_state_dict(d: dict) -> ScalerState:
+    return ScalerState(
+        loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+        unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+        dynamic=bool(d["dynamic"]),
+        scale_window=int(d["scale_window"]),
+        min_loss_scale=float(d["min_loss_scale"]),
+        max_loss_scale=float(d["max_loss_scale"]),
+    )
+
+
+class LossScaler:
+    """Thin OO facade over the pure functions, shaped like the reference class
+    (``apex/amp/scaler.py:42``) for users porting scripts.  Holds a
+    ``ScalerState``; all math is delegated so it stays jit-compatible when the
+    state is threaded through a step function."""
+
+    def __init__(self, loss_scale="dynamic", init_scale=2.0 ** 16,
+                 scale_window=2000, min_loss_scale=1.0, max_loss_scale=2.0 ** 24):
+        self.state = init(loss_scale, init_scale, scale_window,
+                          min_loss_scale, max_loss_scale)
+
+    def loss_scale(self):
+        return float(self.state.loss_scale)
+
+    def scale_loss(self, loss):
+        return scale_loss(self.state, loss)
+
+    def unscale(self, grads):
+        return unscale(self.state, grads)
+
+    def update_scale(self, finite):
+        self.state = update(self.state, finite)
+        return not bool(finite)
+
+    def state_dict(self):
+        return state_dict(self.state)
+
+    def load_state_dict(self, d):
+        self.state = load_state_dict(d)

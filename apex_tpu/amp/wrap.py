@@ -1,0 +1,86 @@
+"""Cast-wrapper factories for the autocast patcher.
+
+Re-design of ``apex/amp/wrap.py`` (``make_cast_wrapper`` :10-29,
+``promote`` :44-70, ``sequence_promote`` :72-92).  Differences born of XLA:
+
+- No cast cache (reference ``cached_cast`` wrap.py:31-39, keyed on fp32 param
+  identity): under ``jit`` repeated casts of the same array are deduplicated by
+  XLA CSE, and a Python-side cache keyed on tracer ids would be wrong across
+  traces.  The cache's *semantic* job (cast each param once per step) is done
+  by the compiler.
+- Wrappers must be trace-transparent: they only inspect aval dtypes, never
+  values.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+
+def _is_float_array(x):
+    return hasattr(x, "dtype") and hasattr(x, "ndim") and \
+        jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _cast(x, dtype):
+    if _is_float_array(x) and x.dtype != dtype:
+        return x.astype(dtype)
+    return x
+
+
+def make_cast_wrapper(orig_fn, dtype):
+    """Cast every floating array argument to ``dtype`` before calling
+    (wrap.py:10-29).  Applied to the low-precision and fp32 lists alike."""
+    @functools.wraps(orig_fn)
+    def wrapper(*args, **kwargs):
+        args = [_cast(a, dtype) for a in args]
+        kwargs = {k: _cast(v, dtype) for k, v in kwargs.items()}
+        return orig_fn(*args, **kwargs)
+    wrapper.__amp_orig__ = orig_fn
+    return wrapper
+
+
+def _widest_type(xs):
+    widest = None
+    for x in xs:
+        if _is_float_array(x):
+            widest = x.dtype if widest is None else jnp.promote_types(widest, x.dtype)
+    return widest
+
+
+def make_promote_wrapper(orig_fn):
+    """Promote mixed floating inputs to the widest type (wrap.py:44-70)."""
+    @functools.wraps(orig_fn)
+    def wrapper(*args, **kwargs):
+        widest = _widest_type(args)
+        if widest is not None:
+            args = [_cast(a, widest) for a in args]
+        return orig_fn(*args, **kwargs)
+    wrapper.__amp_orig__ = orig_fn
+    return wrapper
+
+
+def make_sequence_promote_wrapper(orig_fn):
+    """Promote every element of the leading list/tuple arg (wrap.py:72-92,
+    cat/stack)."""
+    @functools.wraps(orig_fn)
+    def wrapper(seq, *args, **kwargs):
+        if isinstance(seq, (list, tuple)):
+            widest = _widest_type(seq)
+            if widest is not None:
+                seq = type(seq)(_cast(x, widest) for x in seq)
+        return orig_fn(seq, *args, **kwargs)
+    wrapper.__amp_orig__ = orig_fn
+    return wrapper
+
+
+def make_banned_wrapper(orig_fn, name, message):
+    """Raise on use under autocast (reference err_if_arg0_half / BANNED,
+    wrap.py:118-159)."""
+    @functools.wraps(orig_fn)
+    def wrapper(*args, **kwargs):
+        raise RuntimeError(
+            f"amp does not support {name} under autocast. {message}")
+    wrapper.__amp_orig__ = orig_fn
+    return wrapper

@@ -1,0 +1,18 @@
+"""Legacy manual mixed-precision utilities (reference: ``apex/fp16_utils``).
+
+Functional analogs of ``fp16util.py`` (param-list prep, master<->model copies,
+``network_to_half``/``convert_network``) and the legacy ``FP16_Optimizer``
+wrapper (``fp16_optimizer.py:13``) with static/dynamic loss scalers
+(``loss_scaler.py:10,47``; note the legacy defaults differ from amp:
+init 2**32, window 1000).
+"""
+from .fp16util import (
+    prep_param_lists,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    convert_network,
+    tofp16,
+)
+from .fp16_optimizer import FP16_Optimizer
+from .loss_scaler import LossScaler, DynamicLossScaler

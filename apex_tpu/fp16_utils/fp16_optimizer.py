@@ -1,0 +1,79 @@
+"""Legacy FP16_Optimizer wrapper (reference ``apex/fp16_utils/fp16_optimizer.py:13``).
+
+Wraps any apex_tpu fused optimizer with fp32 master weights + loss scaling,
+for scripts ported from the pre-amp API.  Stateful facade over the same pure
+machinery amp uses: ``step``/``backward``-style flow collapses to
+``update(grads)`` since JAX has no .backward().
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..amp import scaler as _scaler
+from ..utils import pytree as _pt
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, model_params, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=True):
+        self.optimizer = init_optimizer
+        self.model_params = model_params
+        self.master_params = _pt.master_params_from(model_params)
+        self.opt_state = init_optimizer.init(self.master_params)
+        args = dynamic_loss_args or {}
+        if dynamic_loss_scale:
+            self.scaler_state = _scaler.init(
+                "dynamic", init_scale=args.get("init_scale", 2.0 ** 32),
+                scale_window=args.get("scale_window", 1000))
+        else:
+            self.scaler_state = _scaler.init(static_loss_scale)
+        self.overflow = False
+
+    @property
+    def loss_scale(self):
+        return float(self.scaler_state.loss_scale)
+
+    def scale_loss(self, loss):
+        """Use in place of ``optimizer.backward(loss)`` (fp16_optimizer.py:373)."""
+        return _scaler.scale_loss(self.scaler_state, loss)
+
+    def step(self, scaled_grads):
+        """update_master_grads + step + master->model copy
+        (fp16_optimizer.py:272,436)."""
+        grads32, finite = _scaler.unscale(self.scaler_state, scaled_grads)
+        new_masters, new_state = self.optimizer.step(
+            self.opt_state, grads32, self.master_params)
+        new_masters = _scaler.apply_if_finite(finite, new_masters,
+                                              self.master_params)
+        new_state = _scaler.apply_if_finite(finite, new_state, self.opt_state)
+        self.scaler_state = _scaler.update(self.scaler_state, finite)
+        self.master_params = new_masters
+        self.opt_state = new_state
+        self.model_params = _pt.master_to_model(new_masters, self.model_params)
+        self.overflow = not bool(finite)
+        return self.model_params
+
+    def clip_master_grads(self, grads, max_norm):
+        """``clip_master_grads`` (fp16_optimizer.py:417-434): global-norm clip."""
+        from ..optimizers._base import global_l2norm
+        norm = global_l2norm(grads)
+        coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+        return jax.tree_util.tree_map(lambda g: g * coef, grads), norm
+
+    def state_dict(self):
+        return {
+            "loss_scaler": _scaler.state_dict(self.scaler_state),
+            "overflow": self.overflow,
+            "master_params": self.master_params,
+            "opt_state": self.opt_state,
+        }
+
+    def load_state_dict(self, d):
+        self.scaler_state = _scaler.load_state_dict(d["loss_scaler"])
+        self.overflow = d["overflow"]
+        self.master_params = d["master_params"]
+        self.opt_state = d["opt_state"]
+        self.model_params = _pt.master_to_model(self.master_params,
+                                                self.model_params)

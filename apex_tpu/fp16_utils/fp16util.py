@@ -1,0 +1,48 @@
+"""Param-list helpers (reference ``apex/fp16_utils/fp16util.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import pytree as _pt
+
+
+def tofp16(params):
+    """``network.half()`` analog (fp16util.py:25-37)."""
+    return _pt.cast_tree(params, jnp.float16)
+
+
+def network_to_half(params):
+    """Blind fp16 conversion (fp16util.py:40-57): everything floating -> fp16."""
+    return _pt.cast_tree(params, jnp.float16)
+
+
+def convert_network(params, dtype, keep_batchnorm_fp32=True):
+    """BN-safe conversion (fp16util.py:60-88)."""
+    return _pt.convert_network(params, dtype, keep_batchnorm_fp32)
+
+
+def prep_param_lists(params, flat_master=False):
+    """(model_params, master_params) pair (fp16util.py:90-155).
+
+    flat_master packs masters into one fp32 buffer via the multi-tensor
+    flattener (the apex_C.flatten path)."""
+    if flat_master:
+        from ..multi_tensor_apply.flattener import TreeFlattener
+        fl = TreeFlattener(params)
+        return params, (fl, fl.flatten(params))
+    return params, _pt.master_params_from(params)
+
+
+def master_params_to_model_params(model_params, master_params):
+    """fp32 masters -> model precision (fp16util.py:158-186)."""
+    if isinstance(master_params, tuple) and len(master_params) == 2 and \
+            hasattr(master_params[0], "unflatten"):
+        fl, flat = master_params
+        return _pt.tree_cast_like(fl.unflatten(flat), model_params)
+    return _pt.master_to_model(master_params, model_params)
+
+
+def model_grads_to_master_grads(model_grads, master_like=None):
+    """fp16 grads -> fp32 (fp16util.py:189-214)."""
+    return jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), model_grads)

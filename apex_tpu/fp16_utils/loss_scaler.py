@@ -1,0 +1,54 @@
+"""Legacy loss scalers (reference ``apex/fp16_utils/loss_scaler.py``).
+
+Kept for API parity with scripts ported from the reference's FP16_Optimizer
+era; new code should use ``apex_tpu.amp.scaler``.  Note the legacy defaults:
+DynamicLossScaler(init_scale=2**32, scale_window=1000) vs amp's 2**16/2000.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..amp import scaler as _scaler
+
+
+class LossScaler:
+    """Static scaler (loss_scaler.py:10-44)."""
+
+    def __init__(self, scale=1.0):
+        self.state = _scaler.init(loss_scale=scale)
+
+    @property
+    def loss_scale(self):
+        return float(self.state.loss_scale)
+
+    def scale_gradient(self, grads):
+        out, _ = _scaler.unscale(self.state, grads)
+        return out
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss):
+        return _scaler.scale_loss(self.state, loss)
+
+
+class DynamicLossScaler:
+    """Dynamic scaler (loss_scaler.py:47-119) with legacy defaults."""
+
+    def __init__(self, init_scale=2.0 ** 32, scale_factor=2.0,
+                 scale_window=1000):
+        self.state = _scaler.init("dynamic", init_scale=init_scale,
+                                  scale_window=scale_window)
+
+    @property
+    def loss_scale(self):
+        return float(self.state.loss_scale)
+
+    def has_overflow(self, grads):
+        return not bool(_scaler.all_finite(grads))
+
+    def update_scale(self, overflow):
+        self.state = _scaler.update(self.state, jnp.logical_not(overflow))
+
+    def backward(self, loss):
+        return _scaler.scale_loss(self.state, loss)

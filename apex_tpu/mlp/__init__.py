@@ -1,0 +1,9 @@
+"""Fused MLP (reference: ``apex/mlp/mlp.py:8-79``, CUDA ``csrc/mlp_cuda.cu``).
+
+The reference chains cuBLAS GEMMs with fused bias+activation epilogues in one
+autograd Function.  Under XLA a jitted chain of ``dot+bias+act`` already fuses
+the epilogues into the matmuls, so the whole-MLP-as-one-call contract is kept
+by a single jittable function; it is registered with amp as a half_function
+exactly like the reference (``mlp.py:24``).
+"""
+from .mlp import MLP, mlp_function

@@ -1,0 +1,57 @@
+"""Multi-tensor apply: flat-buffer engine + Pallas kernels.
+
+Facade mirroring ``apex/multi_tensor_apply/__init__.py:1-3`` /
+``multi_tensor_apply.py:3-30``: a callable that applies a fused op to lists of
+tensors.  On TPU the "list of tensors" is first packed into a flat buffer
+(TreeFlattener): ``multi_tensor_applier(op, tensor_lists, *args)`` packs each
+list and calls ``op`` on the flat buffers (the reference's noop_flag becomes
+the kernel's overflow-flag return value).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .flattener import TreeFlattener, LANE, DEFAULT_CHUNK
+from . import kernels
+from .kernels import (
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    fused_adam_flat,
+    fused_sgd_flat,
+    fused_lamb_stage1_flat,
+    fused_adagrad_flat,
+)
+
+
+class MultiTensorApply:
+    """Callable facade (reference ``MultiTensorApply`` with chunk_size 2048*32).
+
+    ``op`` is one of the kernel functions above; tensor *lists* are packed on
+    the fly (for steady-state training prefer keeping state flat and calling
+    the ``*_flat`` kernels directly — the fused optimizers do).
+    """
+
+    available = True
+
+    def __init__(self, chunk_size: int = DEFAULT_CHUNK):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, tensor_lists, *args, **kwargs):
+        flats = []
+        flattener = None
+        for lst in tensor_lists:
+            flattener = TreeFlattener(list(lst), chunk=self.chunk_size)
+            flats.append(flattener.flatten(list(lst)))
+        out = op(*flats, *args, **kwargs)
+        return out, flattener
+
+
+multi_tensor_applier = MultiTensorApply()
+
+__all__ = [
+    "TreeFlattener", "LANE", "DEFAULT_CHUNK", "kernels",
+    "multi_tensor_scale", "multi_tensor_axpby", "multi_tensor_l2norm",
+    "fused_adam_flat", "fused_sgd_flat", "fused_lamb_stage1_flat",
+    "fused_adagrad_flat", "MultiTensorApply", "multi_tensor_applier",
+]

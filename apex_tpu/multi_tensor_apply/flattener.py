@@ -1,0 +1,127 @@
+"""Flat-buffer packing of param pytrees — the TPU replacement for the CUDA
+multi-tensor-apply pointer-table engine.
+
+The reference launches one kernel over a list of tensor pointers
+(``csrc/multi_tensor_apply.cuh:16-142``: ``TensorListMetadata`` with chunked
+320-block launches).  TPU kernels cannot take address tables, so we pack the
+tree into one contiguous buffer per dtype group (the ``apex_C.flatten`` analog,
+``csrc/flatten_unflatten.cpp:5-18``), aligned so that:
+
+- every leaf starts on a 128-lane row boundary (LANE=128), letting per-tensor
+  reductions (LAMB trust ratios, per-tensor l2norm) be computed as row-sums +
+  a static segment-sum — preserving the per-tensor semantics of
+  ``multi_tensor_l2norm_kernel.cu`` without pointer lists;
+- the total is padded to a whole number of kernel chunks so the Pallas grid
+  needs no bounds checks.
+
+Packing/unpacking are pure jnp ops inside jit (XLA lowers them to copies it
+can schedule/fuse); the *metadata* (offsets, segment ids) is computed once per
+tree structure in Python and closed over statically.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+LANE = 128            # TPU lane width; per-leaf alignment quantum
+DEFAULT_CHUNK = 128 * 1024   # elements per kernel grid step (1024 rows x 128)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+class TreeFlattener:
+    """Precomputed packing plan for one pytree structure.
+
+    Build once from a template tree; ``flatten``/``unflatten`` then run under
+    jit with zero host logic.  All leaves are packed into a single buffer of
+    ``dtype`` (default fp32 — the master-weight layout used by the fused
+    optimizers).
+    """
+
+    def __init__(self, tree, dtype=jnp.float32, chunk: int = DEFAULT_CHUNK):
+        leaves, self.treedef = jax.tree_util.tree_flatten(tree)
+        if chunk % LANE:
+            raise ValueError(f"chunk must be a multiple of {LANE}")
+        self.dtype = jnp.dtype(dtype)
+        self.chunk = int(chunk)
+        self.shapes = [l.shape for l in leaves]
+        self.dtypes = [l.dtype for l in leaves]
+        self.sizes = [int(np.prod(s)) if len(s) else 1 for s in self.shapes]
+        self.padded_sizes = [_round_up(s, LANE) for s in self.sizes]
+        self.offsets = np.concatenate([[0], np.cumsum(self.padded_sizes)]).astype(np.int64)
+        used = int(self.offsets[-1])
+        self.total = max(_round_up(used, self.chunk), self.chunk)
+        self.num_chunks = self.total // self.chunk
+        self.num_leaves = len(leaves)
+
+        # row (= LANE elements) -> leaf index; padding rows map to segment
+        # num_leaves and are dropped after segment_sum.
+        rows = self.total // LANE
+        row_seg = np.full((rows,), self.num_leaves, dtype=np.int32)
+        for i, (off, size) in enumerate(zip(self.offsets[:-1], self.sizes)):
+            r0 = off // LANE
+            r1 = (off + _round_up(size, LANE)) // LANE
+            row_seg[r0:r1] = i
+        self._row_segments = jnp.asarray(row_seg)
+
+    # -- packing -------------------------------------------------------------
+
+    def flatten(self, tree) -> jnp.ndarray:
+        """Pack tree -> (total,) buffer of self.dtype (zero padding)."""
+        leaves = self.treedef.flatten_up_to(tree)
+        parts: List[jnp.ndarray] = []
+        for leaf, size, padded in zip(leaves, self.sizes, self.padded_sizes):
+            flat = jnp.ravel(leaf).astype(self.dtype)
+            if padded != size:
+                flat = jnp.pad(flat, (0, padded - size))
+            parts.append(flat)
+        out = jnp.concatenate(parts) if parts else jnp.zeros((0,), self.dtype)
+        if self.total != int(self.offsets[-1]):
+            out = jnp.pad(out, (0, self.total - int(self.offsets[-1])))
+        return out
+
+    def unflatten(self, flat, like=None, dtype=None):
+        """Unpack (total,) buffer -> tree.  ``dtype=None`` restores each leaf's
+        original dtype; pass e.g. jnp.float32 to force."""
+        leaves = []
+        for i in range(self.num_leaves):
+            off = int(self.offsets[i])
+            piece = jax.lax.slice(flat, (off,), (off + self.sizes[i],))
+            tgt = dtype or self.dtypes[i]
+            leaves.append(piece.reshape(self.shapes[i]).astype(tgt))
+        return self.treedef.unflatten(leaves)
+
+    # -- per-tensor reductions ----------------------------------------------
+
+    def per_tensor_sumsq(self, flat) -> jnp.ndarray:
+        """Per-leaf sum of squares from the flat buffer: the per-tensor part of
+        ``multi_tensor_l2norm`` (``multi_tensor_l2norm_kernel.cu:28-242``).
+        Returns (num_leaves,) fp32."""
+        rows = flat.reshape(-1, LANE).astype(jnp.float32)
+        row_sums = jnp.sum(rows * rows, axis=1)
+        segs = jax.ops.segment_sum(
+            row_sums, self._row_segments, num_segments=self.num_leaves + 1)
+        return segs[: self.num_leaves]
+
+    def per_tensor_norm(self, flat) -> jnp.ndarray:
+        return jnp.sqrt(self.per_tensor_sumsq(flat))
+
+    def broadcast_per_tensor(self, values) -> jnp.ndarray:
+        """Expand (num_leaves,) values to a (total,) flat buffer by segment —
+        the "per-tensor scalar visible to every element" trick the CUDA side
+        gets from its pointer table (used by LAMB stage 2)."""
+        vals = jnp.concatenate([values.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
+        per_row = vals[self._row_segments]          # (rows,)
+        return jnp.repeat(per_row, LANE)
+
+    def broadcast_rows(self, values) -> jnp.ndarray:
+        """(num_leaves,) -> (rows,) per-row values (cheaper than full
+        broadcast; kernels index rows)."""
+        vals = jnp.concatenate([values.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
+        return vals[self._row_segments]

@@ -1,0 +1,326 @@
+"""Pallas TPU kernels for the multi-tensor engine.
+
+These are the TPU equivalents of the ``amp_C`` kernel family
+(``csrc/amp_C_frontend.cpp:1-136`` + ``multi_tensor_*.cu``): fused elementwise
+updates over *flat packed buffers* (see ``flattener.py``) instead of pointer
+tables.  Each kernel views the flat (total,) buffer as (rows, 128) and walks a
+1-D grid of chunks; per-chunk blocks live in VMEM, hyperparameter scalars ride
+in SMEM, and outputs alias their inputs (donation) so updates are in-place in
+HBM like the CUDA originals.
+
+The overflow short-circuit (``noop_flag`` in ``multi_tensor_apply.cuh``)
+becomes an i32 "overflow" output accumulated across the sequential TPU grid.
+
+On non-TPU backends (CPU tests) kernels run in Pallas interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flattener import LANE, DEFAULT_CHUNK
+
+_BR = DEFAULT_CHUNK // LANE  # block rows per grid step
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_rows(total: int) -> int:
+    """Largest block (<= DEFAULT_CHUNK) that evenly divides the buffer, so
+    kernels work for any TreeFlattener chunk size, not just the default."""
+    rows = total // LANE
+    br = min(_BR, rows)
+    while br > 1 and rows % br:
+        br -= 1
+    return max(br, 1)
+
+
+def _grid_call(kernel, flats, out_dtypes, *, scalars=None, aliases=None,
+               with_flag=False, block_rows=None):
+    """Run ``kernel`` over 1-D flat buffers chunked as (block_rows, LANE).
+
+    flats: list of (total,) arrays (equal length).  scalars: optional (1, S)
+    f32 array placed in SMEM.  aliases: dict input_index->output_index for
+    in-place donation.  with_flag: append an i32 (1,1) overflow-flag output
+    accumulated over the grid.
+    """
+    total = flats[0].shape[0]
+    if block_rows is None:
+        block_rows = _block_rows(total)
+    assert total % (block_rows * LANE) == 0, (total, block_rows)
+    rows = total // LANE
+    grid = rows // block_rows
+
+    views = [f.reshape(rows, LANE) for f in flats]
+    in_specs = []
+    ins = []
+    if scalars is not None:
+        in_specs.append(pl.BlockSpec(
+            scalars.shape, lambda i: (0, 0), memory_space=pltpu.SMEM))
+        ins.append(scalars)
+    for v in views:
+        in_specs.append(pl.BlockSpec(
+            (block_rows, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM))
+        ins.append(v)
+
+    out_shape = [jax.ShapeDtypeStruct((rows, LANE), d) for d in out_dtypes]
+    out_specs = [pl.BlockSpec((block_rows, LANE), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+                 for _ in out_dtypes]
+    if with_flag:
+        out_shape.append(jax.ShapeDtypeStruct((1, 1), jnp.int32))
+        out_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                      memory_space=pltpu.SMEM))
+
+    io_aliases = {}
+    if aliases:
+        off = 0 if scalars is None else 1
+        io_aliases = {k + off: v for k, v in aliases.items()}
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases=io_aliases,
+        interpret=_interpret(),
+    )(*ins)
+    if not isinstance(outs, (list, tuple)):
+        outs = (outs,)
+    outs = list(outs)
+    flag = None
+    if with_flag:
+        flag = outs.pop()[0, 0]
+    outs = [o.reshape(total) for o in outs]
+    return outs, flag
+
+
+# --------------------------------------------------------------------------
+# multi_tensor_scale (multi_tensor_scale_kernel.cu): out = in * scale,
+# overflow flag on non-finite input/output.
+# --------------------------------------------------------------------------
+
+def multi_tensor_scale(flat_in, scale, out_dtype=None):
+    out_dtype = jnp.dtype(out_dtype or flat_in.dtype)
+    scalars = jnp.reshape(jnp.asarray(scale, jnp.float32), (1, 1))
+
+    def kernel(s_ref, x_ref, o_ref, flag_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            flag_ref[0, 0] = 0
+
+        y = x_ref[:].astype(jnp.float32) * s_ref[0, 0]
+        o_ref[:] = y.astype(o_ref.dtype)
+
+        @pl.when(jnp.logical_not(jnp.all(jnp.isfinite(y))))
+        def _():
+            flag_ref[0, 0] = 1
+
+    (out,), flag = _grid_call(kernel, [flat_in], [out_dtype],
+                              scalars=scalars, with_flag=True)
+    return out, flag
+
+
+# --------------------------------------------------------------------------
+# multi_tensor_axpby (multi_tensor_axpby_kernel.cu): out = a*x + b*y
+# --------------------------------------------------------------------------
+
+def multi_tensor_axpby(flat_x, flat_y, a, b, out_dtype=None):
+    out_dtype = jnp.dtype(out_dtype or flat_x.dtype)
+    scalars = jnp.stack([jnp.asarray(a, jnp.float32),
+                         jnp.asarray(b, jnp.float32)]).reshape(1, 2)
+
+    def kernel(s_ref, x_ref, y_ref, o_ref, flag_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            flag_ref[0, 0] = 0
+
+        r = (x_ref[:].astype(jnp.float32) * s_ref[0, 0]
+             + y_ref[:].astype(jnp.float32) * s_ref[0, 1])
+        o_ref[:] = r.astype(o_ref.dtype)
+
+        @pl.when(jnp.logical_not(jnp.all(jnp.isfinite(r))))
+        def _():
+            flag_ref[0, 0] = 1
+
+    (out,), flag = _grid_call(kernel, [flat_x, flat_y], [out_dtype],
+                              scalars=scalars, with_flag=True)
+    return out, flag
+
+
+# --------------------------------------------------------------------------
+# multi_tensor_l2norm (multi_tensor_l2norm_kernel.cu two-stage reduction):
+# stage 1 in Pallas (per-chunk partials), stage 2 is a tiny XLA reduce.
+# --------------------------------------------------------------------------
+
+def multi_tensor_l2norm(flat_in):
+    total = flat_in.shape[0]
+    rows = total // LANE
+    br = _block_rows(total)
+    grid = rows // br
+
+    def kernel(x_ref, part_ref):
+        x = x_ref[:].astype(jnp.float32)
+        part_ref[0, 0] = jnp.sum(x * x)
+
+    partials = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((br, LANE), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0),
+                               memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((grid, 1), jnp.float32),
+        interpret=_interpret(),
+    )(flat_in.reshape(rows, LANE))
+    return jnp.sqrt(jnp.sum(partials))
+
+
+# --------------------------------------------------------------------------
+# multi_tensor_adam (multi_tensor_adam.cu AdamFunctor): Adam / AdamW on flat
+# master buffers, optional low-precision model-copy output (the reference's
+# fp16 output-params mode, fused_adam_cuda.cpp:79-85).
+# scalars layout: [lr, beta1, beta2, eps, wd, rc1, rc2, inv_scale]
+#   rc1 = 1/(1-beta1^t), rc2 = 1/(1-beta2^t)
+# --------------------------------------------------------------------------
+
+def fused_adam_flat(flat_g, flat_p, flat_m, flat_v, scalars, *,
+                    adam_w_mode=True, model_dtype=None):
+    out_dtypes = [jnp.float32, jnp.float32, jnp.float32]
+    if model_dtype is not None:
+        out_dtypes.append(jnp.dtype(model_dtype))
+
+    def kernel(s_ref, g_ref, p_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref,
+               *maybe_model):
+        lr, b1, b2, eps = s_ref[0, 0], s_ref[0, 1], s_ref[0, 2], s_ref[0, 3]
+        wd, rc1, rc2, inv_scale = s_ref[0, 4], s_ref[0, 5], s_ref[0, 6], s_ref[0, 7]
+        g = g_ref[:].astype(jnp.float32) * inv_scale
+        p = p_ref[:]
+        if not adam_w_mode:
+            g = g + wd * p          # classic L2 (ADAM_MODE_0)
+        m = b1 * m_ref[:] + (1.0 - b1) * g
+        v = b2 * v_ref[:] + (1.0 - b2) * g * g
+        update = (m * rc1) / (jnp.sqrt(v * rc2) + eps)
+        if adam_w_mode:
+            update = update + wd * p  # decoupled decay (ADAM_MODE_1)
+        p_new = p - lr * update
+        po_ref[:] = p_new
+        mo_ref[:] = m
+        vo_ref[:] = v
+        if maybe_model:
+            maybe_model[0][:] = p_new.astype(maybe_model[0].dtype)
+
+    aliases = {1: 0, 2: 1, 3: 2}  # p, m, v in-place
+    outs, _ = _grid_call(kernel, [flat_g, flat_p, flat_m, flat_v], out_dtypes,
+                         scalars=scalars, aliases=aliases)
+    return outs  # [p, m, v] (+ model copy)
+
+
+# --------------------------------------------------------------------------
+# multi_tensor_sgd (multi_tensor_sgd_kernel.cu): momentum SGD with the
+# reference's knobs (nesterov, dampening, wd placement, first_run).
+# scalars: [lr, momentum, dampening, wd, inv_scale]
+# --------------------------------------------------------------------------
+
+def fused_sgd_flat(flat_g, flat_p, flat_mom, scalars, *, nesterov=False,
+                   first_run=False, wd_after_momentum=False, model_dtype=None):
+    out_dtypes = [jnp.float32, jnp.float32]
+    if model_dtype is not None:
+        out_dtypes.append(jnp.dtype(model_dtype))
+
+    def kernel(s_ref, g_ref, p_ref, mom_ref, po_ref, mo_ref, *maybe_model):
+        lr, mu, damp, wd, inv_scale = (s_ref[0, 0], s_ref[0, 1], s_ref[0, 2],
+                                       s_ref[0, 3], s_ref[0, 4])
+        g = g_ref[:].astype(jnp.float32) * inv_scale
+        p = p_ref[:]
+        if not wd_after_momentum:
+            g = g + wd * p
+        if first_run:
+            mom = g
+        else:
+            mom = mu * mom_ref[:] + (1.0 - damp) * g
+        upd = g + mu * mom if nesterov else mom
+        if wd_after_momentum:
+            upd = upd + wd * p
+        p_new = p - lr * upd
+        po_ref[:] = p_new
+        mo_ref[:] = mom
+        if maybe_model:
+            maybe_model[0][:] = p_new.astype(maybe_model[0].dtype)
+
+    outs, _ = _grid_call(kernel, [flat_g, flat_p, flat_mom], out_dtypes,
+                         scalars=scalars, aliases={1: 0, 2: 1})
+    return outs
+
+
+# --------------------------------------------------------------------------
+# multi_tensor_lamb stage 1 (multi_tensor_lamb.cu LAMBStage1Functor): m/v
+# update + unscaled LAMB step direction, with global-grad-norm clipping.
+# Stage 2 (per-tensor trust ratio) runs as XLA segment ops in the optimizer —
+# the per-tensor norms come from TreeFlattener.per_tensor_sumsq.
+# scalars: [beta1, beta2, eps, wd, rc1, rc2, clip, inv_scale]
+#   clip = 1.0 / max(1, global_norm/max_grad_norm)
+# --------------------------------------------------------------------------
+
+def fused_lamb_stage1_flat(flat_g, flat_p, flat_m, flat_v, scalars, *,
+                           adam_w_mode=True):
+    def kernel(s_ref, g_ref, p_ref, m_ref, v_ref, u_ref, mo_ref, vo_ref):
+        b1, b2, eps, wd = s_ref[0, 0], s_ref[0, 1], s_ref[0, 2], s_ref[0, 3]
+        rc1, rc2, clip, inv_scale = (s_ref[0, 4], s_ref[0, 5], s_ref[0, 6],
+                                     s_ref[0, 7])
+        g = g_ref[:].astype(jnp.float32) * inv_scale * clip
+        p = p_ref[:]
+        if not adam_w_mode:
+            g = g + wd * p
+        m = b1 * m_ref[:] + (1.0 - b1) * g
+        v = b2 * v_ref[:] + (1.0 - b2) * g * g
+        u = (m * rc1) / (jnp.sqrt(v * rc2) + eps)
+        if adam_w_mode:
+            u = u + wd * p
+        u_ref[:] = u
+        mo_ref[:] = m
+        vo_ref[:] = v
+
+    outs, _ = _grid_call(kernel, [flat_g, flat_p, flat_m, flat_v],
+                         [jnp.float32, jnp.float32, jnp.float32],
+                         scalars=scalars, aliases={2: 1, 3: 2})
+    return outs  # [update, m, v]
+
+
+# --------------------------------------------------------------------------
+# multi_tensor_adagrad (multi_tensor_adagrad.cu): h += g^2; p -= lr*g/(sqrt+eps)
+# scalars: [lr, eps, wd, inv_scale]
+# --------------------------------------------------------------------------
+
+def fused_adagrad_flat(flat_g, flat_p, flat_h, scalars, *, model_dtype=None):
+    out_dtypes = [jnp.float32, jnp.float32]
+    if model_dtype is not None:
+        out_dtypes.append(jnp.dtype(model_dtype))
+
+    def kernel(s_ref, g_ref, p_ref, h_ref, po_ref, ho_ref, *maybe_model):
+        lr, eps, wd, inv_scale = (s_ref[0, 0], s_ref[0, 1], s_ref[0, 2],
+                                  s_ref[0, 3])
+        g = g_ref[:].astype(jnp.float32) * inv_scale
+        p = p_ref[:]
+        g = g + wd * p
+        h = h_ref[:] + g * g
+        p_new = p - lr * g / (jnp.sqrt(h) + eps)
+        po_ref[:] = p_new
+        ho_ref[:] = h
+        if maybe_model:
+            maybe_model[0][:] = p_new.astype(maybe_model[0].dtype)
+
+    outs, _ = _grid_call(kernel, [flat_g, flat_p, flat_h], out_dtypes,
+                         scalars=scalars, aliases={1: 0, 2: 1})
+    return outs

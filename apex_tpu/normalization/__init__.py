@@ -1,0 +1,6 @@
+"""Normalization layers (reference: ``apex/normalization``)."""
+from .fused_layer_norm import (
+    FusedLayerNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+)

@@ -1,0 +1,9 @@
+"""Fused optimizers (reference: ``apex/optimizers``) — pure-functional
+algorithm objects with multi-tensor fused update paths."""
+
+from .fused_adam import FusedAdam, FusedAdamState
+from .fused_sgd import FusedSGD, FusedSGDState
+from .fused_lamb import FusedLAMB, FusedLAMBState
+from .fused_novograd import FusedNovoGrad, FusedNovoGradState
+from .fused_adagrad import FusedAdagrad, FusedAdagradState
+from ._base import FusedOptimizer, global_l2norm

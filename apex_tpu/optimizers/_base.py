@@ -1,0 +1,84 @@
+"""Shared machinery for the fused optimizers (reference: ``apex/optimizers``).
+
+Design: each optimizer is a stateless *algorithm object* (hyperparams only)
+with pure ``init(params) -> state`` and ``step(state, grads, params, ...) ->
+(new_params, new_state)`` methods, so the whole update nests under ``jit`` /
+``pjit`` and threads through scan-based training loops.  Two interchangeable
+implementations:
+
+- ``impl="xla"``: per-leaf ``tree_map`` updates.  Under jit, XLA emits one
+  fused elementwise loop per leaf inside a single executable — the kernel
+  -launch-overhead problem the CUDA multi-tensor engine solves does not exist
+  inside one XLA program.
+- ``impl="fused"``: the Pallas flat-buffer path (``multi_tensor_apply``) —
+  optimizer state (and optionally master params) live permanently in one
+  contiguous fp32 buffer; one chunked Pallas kernel performs the update.
+  This is the architectural mirror of ``amp_C`` and the perf-measurement
+  vehicle for BASELINE's "FusedLAMB step-time" metric.
+
+Both produce identical numerics (tested against torch.optim oracles like
+``tests/L0/run_optimizers/test_adam.py:8-60``).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..multi_tensor_apply.flattener import TreeFlattener
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+def tree_zeros_f32(params):
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def global_l2norm(tree):
+    """Global grad norm across a pytree (``multi_tensor_l2norm`` +
+    final-reduce, fused_lamb.py:123-135)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(_f32(l) ** 2) for l in leaves))
+
+
+def resolve(value, count):
+    """Hyperparams may be schedules: callables of the int step count."""
+    if callable(value):
+        return value(count)
+    return value
+
+
+class FusedOptimizer:
+    """Base: handles impl selection and the flattener for the fused path."""
+
+    def __init__(self, lr, weight_decay=0.0, impl="xla"):
+        if impl not in ("xla", "fused"):
+            raise ValueError(f"impl must be 'xla' or 'fused', got {impl!r}")
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.impl = impl
+        self._flattener: Optional[TreeFlattener] = None
+        self._flattener_key = None
+
+    def flattener_for(self, params) -> TreeFlattener:
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        key = (treedef, tuple(l.shape for l in leaves),
+               tuple(jnp.dtype(l.dtype) for l in leaves))
+        if self._flattener is None or self._flattener_key != key:
+            # rebuilt when the param set/shapes change (add_param_group analog,
+            # _process_optimizer.py:469-489) — a retrace, not a runtime error
+            self._flattener = TreeFlattener(params)
+            self._flattener_key = key
+        return self._flattener
+
+    # optax-style aliases so apex_tpu optimizers drop into optax training loops
+    def update(self, grads, state, params):
+        new_params, new_state = self.step(state, grads, params)
+        updates = jax.tree_util.tree_map(lambda n, p: n - p, new_params, params)
+        return updates, new_state

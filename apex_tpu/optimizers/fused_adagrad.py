@@ -1,0 +1,62 @@
+"""FusedAdagrad (reference ``apex/optimizers/fused_adagrad.py:5``, kernel
+``csrc/multi_tensor_adagrad.cu``): h += g²; p -= lr·g/(√h+eps), with L2
+weight decay folded into the grad."""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ._base import FusedOptimizer, tree_zeros_f32, resolve, _f32
+from ..multi_tensor_apply import kernels
+
+
+class FusedAdagradState(NamedTuple):
+    count: jnp.ndarray
+    h: Any
+
+
+class FusedAdagrad(FusedOptimizer):
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 set_grad_none=True, impl="xla"):
+        super().__init__(lr, weight_decay, impl)
+        self.eps = eps
+
+    def init(self, params) -> FusedAdagradState:
+        if self.impl == "fused":
+            fl = self.flattener_for(params)
+            return FusedAdagradState(jnp.zeros((), jnp.int32),
+                                     jnp.zeros((fl.total,), jnp.float32))
+        return FusedAdagradState(jnp.zeros((), jnp.int32),
+                                 tree_zeros_f32(params))
+
+    def step(self, state, grads, params, *, scale=1.0, lr=None):
+        count = state.count + 1
+        lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
+                         jnp.float32)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+
+        if self.impl == "fused":
+            fl = self.flattener_for(params)
+            scalars = jnp.stack([lr, jnp.float32(self.eps), wd,
+                                 inv_scale]).reshape(1, 4)
+            flat_p, h = kernels.fused_adagrad_flat(
+                fl.flatten(grads), fl.flatten(params), state.h, scalars)
+            return fl.unflatten(flat_p), FusedAdagradState(count, h)
+
+        eps = self.eps
+
+        def upd(g, p, h):
+            g = _f32(g) * inv_scale
+            p32 = _f32(p)
+            g = g + wd * p32
+            h_new = h + g * g
+            return (p32 - lr * g / (jnp.sqrt(h_new) + eps)).astype(p.dtype), h_new
+
+        out = jax.tree_util.tree_map(upd, grads, params, state.h)
+        is_t = lambda x: isinstance(x, tuple)
+        new_params = jax.tree_util.tree_map(lambda o: o[0], out, is_leaf=is_t)
+        new_h = jax.tree_util.tree_map(lambda o: o[1], out, is_leaf=is_t)
+        return new_params, FusedAdagradState(count, new_h)

@@ -1,0 +1,116 @@
+"""FusedAdam — Adam/AdamW with the multi-tensor fused update.
+
+TPU re-design of ``apex/optimizers/fused_adam.py:4-172`` (CUDA kernel
+``csrc/multi_tensor_adam.cu``).  Same knobs: ``adam_w_mode`` (decoupled decay,
+fused_adam.py:71), ``bias_correction``, grad scale for amp interop.  Extra TPU
+affordance: ``model_dtype`` emits a low-precision param copy from the same
+kernel pass (the reference's fp16-output-params mode,
+``fused_adam_cuda.cpp:79-85``).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ._base import FusedOptimizer, tree_zeros_f32, resolve, _f32
+from ..multi_tensor_apply import kernels
+
+
+class FusedAdamState(NamedTuple):
+    count: jnp.ndarray   # i32 step counter
+    m: Any               # pytree (xla) or flat buffer (fused)
+    v: Any
+
+
+class FusedAdam(FusedOptimizer):
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-8, adam_w_mode=True, weight_decay=0.0, amsgrad=False,
+                 model_dtype=None, impl="xla"):
+        super().__init__(lr, weight_decay, impl)
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant "
+                               "(matches reference fused_adam.py:60).")
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        # emit low-precision param copies in the same pass (the reference's
+        # fp16 output-params mode); None = params keep their own dtypes
+        self.model_dtype = None if model_dtype is None else jnp.dtype(model_dtype)
+
+    def init(self, params) -> FusedAdamState:
+        if self.impl == "fused":
+            fl = self.flattener_for(params)
+            zeros = jnp.zeros((fl.total,), jnp.float32)
+            return FusedAdamState(jnp.zeros((), jnp.int32), zeros, zeros)
+        z = tree_zeros_f32(params)
+        return FusedAdamState(jnp.zeros((), jnp.int32), z,
+                              tree_zeros_f32(params))
+
+    def _corrections(self, count):
+        t = count.astype(jnp.float32)
+        if self.bias_correction:
+            rc1 = 1.0 / (1.0 - self.beta1 ** t)
+            rc2 = 1.0 / (1.0 - self.beta2 ** t)
+        else:
+            rc1 = rc2 = jnp.ones((), jnp.float32)
+        return rc1, rc2
+
+    def step(self, state, grads, params, *, scale=1.0, lr=None):
+        """One fused update.  ``scale`` divides grads (amp loss-scale interop,
+        reference step(..., scale) API); returns (new_params, new_state)."""
+        count = state.count + 1
+        lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
+                         jnp.float32)
+        rc1, rc2 = self._corrections(count)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+
+        if self.impl == "fused":
+            return self._step_fused(state, grads, params, count, lr, rc1, rc2,
+                                    inv_scale, wd)
+
+        b1, b2, eps, adamw = self.beta1, self.beta2, self.eps, self.adam_w_mode
+
+        out_dtype = self.model_dtype
+
+        def upd(g, p, m, v):
+            g = _f32(g) * inv_scale
+            p32 = _f32(p)
+            if not adamw:
+                g = g + wd * p32
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            u = (m * rc1) / (jnp.sqrt(v * rc2) + eps)
+            if adamw:
+                u = u + wd * p32
+            return (p32 - lr * u).astype(out_dtype or p.dtype), m, v
+
+        out = jax.tree_util.tree_map(upd, grads, params, state.m, state.v)
+        new_params = jax.tree_util.tree_map(lambda o: o[0], out,
+                                            is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree_util.tree_map(lambda o: o[1], out,
+                                       is_leaf=lambda x: isinstance(x, tuple))
+        new_v = jax.tree_util.tree_map(lambda o: o[2], out,
+                                       is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, FusedAdamState(count, new_m, new_v)
+
+    def _step_fused(self, state, grads, params, count, lr, rc1, rc2,
+                    inv_scale, wd):
+        fl = self.flattener_for(params)
+        flat_g = fl.flatten(grads)
+        flat_p = fl.flatten(params)
+        scalars = jnp.stack([lr, jnp.float32(self.beta1),
+                             jnp.float32(self.beta2), jnp.float32(self.eps),
+                             wd, rc1, rc2, inv_scale]).reshape(1, 8)
+        outs = kernels.fused_adam_flat(
+            flat_g, flat_p, state.m, state.v, scalars,
+            adam_w_mode=self.adam_w_mode, model_dtype=self.model_dtype)
+        if self.model_dtype is not None:
+            flat_p, m, v, flat_model = outs
+            return (fl.unflatten(flat_model, dtype=self.model_dtype),
+                    FusedAdamState(count, m, v))
+        flat_p, m, v = outs
+        return fl.unflatten(flat_p), FusedAdamState(count, m, v)

@@ -1,0 +1,18 @@
+"""Distributed training over a TPU device mesh (reference: ``apex/parallel``).
+
+The reference's NCCL bucket machinery maps onto SPMD: gradient allreduce is a
+``psum`` inside the jitted step, SyncBatchNorm's cross-rank Welford merge is an
+``all_gather`` over a mesh axis, process groups are mesh sub-axes.
+"""
+from . import mesh
+from .mesh import (
+    create_mesh,
+    create_grouped_mesh,
+    use_mesh,
+    current_mesh,
+    initialize_distributed,
+    DATA_AXIS,
+    GROUP_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+)

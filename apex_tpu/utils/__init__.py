@@ -1,0 +1,2 @@
+"""Shared pytree/casting utilities."""
+from . import pytree

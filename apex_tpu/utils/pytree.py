@@ -1,0 +1,102 @@
+"""Pytree casting/partition helpers — the functional replacement for the
+reference's model-casting machinery.
+
+Covers: ``to_type``/``applier`` (``apex/amp/_initialize.py:21-61``),
+``convert_network`` batchnorm-safe casting (``apex/fp16_utils/fp16util.py:60``,
+used by the O2/O5 path ``_initialize.py:176-182``), and
+``prep_param_lists``/master-params copies (``fp16util.py:90,158``).
+In JAX, "the model" is a pytree of params; casting a model is a tree_map and
+batchnorm-exemption is a predicate over tree paths instead of an isinstance
+check over ``nn.Module``s.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Path components that identify normalization params that should stay fp32 when
+# keep_batchnorm_fp32 is set.  Matches flax (`BatchNorm_0`), haiku (`batch_norm`),
+# and common hand-rolled names.  The reference's analog is the isinstance check
+# on _BatchNorm modules in convert_network (fp16util.py:60-88).
+_NORM_PAT = re.compile(
+    r"(batch[_]?norm|batch_stats|\bbn\b|group[_]?norm|layer[_]?norm|\bnorm\b)",
+    re.IGNORECASE)
+
+
+def is_norm_path(path) -> bool:
+    keys = []
+    for p in path:
+        if hasattr(p, "key"):
+            keys.append(str(p.key))
+        elif hasattr(p, "name"):
+            keys.append(str(p.name))
+        elif hasattr(p, "idx"):
+            keys.append(str(p.idx))
+        else:
+            keys.append(str(p))
+    return bool(_NORM_PAT.search("/".join(keys)))
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def cast_tree(tree, dtype, *, predicate: Optional[Callable] = None):
+    """Cast all floating leaves to ``dtype``; ints/bools pass through
+    (``to_type``, ``_initialize.py:21-35``).  ``predicate(path, leaf)`` may
+    veto the cast for specific leaves (returns True -> keep fp32)."""
+    if dtype is None:
+        return tree
+    dtype = jnp.dtype(dtype)
+
+    def _cast(path, x):
+        if not _is_float(x):
+            return x
+        if predicate is not None and predicate(path, x):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+def convert_network(params, dtype, keep_batchnorm_fp32: bool = True):
+    """BN-safe whole-model cast: the ``convert_network`` analog
+    (``fp16util.py:60``).  With ``keep_batchnorm_fp32``, any param whose tree
+    path looks like a normalization layer stays fp32."""
+    pred = (lambda path, x: is_norm_path(path)) if keep_batchnorm_fp32 else None
+    return cast_tree(params, dtype, predicate=pred)
+
+
+def cast_inputs(args, kwargs, dtype):
+    """Patched-forward input cast (``_initialize.py:194-201``): cast floating
+    array leaves of (args, kwargs) to the model compute dtype."""
+    if dtype is None:
+        return args, kwargs
+    caster = lambda x: x.astype(dtype) if _is_float(x) else x
+    return (jax.tree_util.tree_map(caster, args),
+            jax.tree_util.tree_map(caster, kwargs))
+
+
+def master_params_from(params):
+    """Create fp32 master copies of low-precision params
+    (``lazy_init_with_master_weights``, ``_process_optimizer.py:28-90`` /
+    ``prep_param_lists``, ``fp16util.py:90``)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32) if _is_float(p) else p, params)
+
+
+def master_to_model(master, model_like):
+    """fp32 master -> model-precision copy (``master_params_to_model_params``,
+    ``fp16util.py:158``; done via multi_tensor_scale in the reference,
+    ``_process_optimizer.py:14`` — here XLA fuses the cast)."""
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype) if _is_float(p) else m, master, model_like)
+
+
+def tree_cast_like(src, like):
+    """Cast each leaf of src to the dtype of the corresponding leaf of like."""
+    return jax.tree_util.tree_map(
+        lambda s, l: s.astype(l.dtype) if _is_float(l) else s, src, like)

@@ -1,0 +1,96 @@
+"""Oracle tests for the multi-tensor flat engine + Pallas kernels —
+mirrors tests/L0/run_amp/test_multi_tensor_scale.py / _axpby / _l2norm
+(fused vs reference numerics + overflow-flag cases), run in Pallas
+interpret mode on CPU."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.multi_tensor_apply import (
+    TreeFlattener, multi_tensor_scale, multi_tensor_axpby, multi_tensor_l2norm)
+
+
+def make_tree(key, shapes, dtype=jnp.float32):
+    ks = jax.random.split(key, len(shapes))
+    return {f"p{i}": jax.random.normal(k, s, dtype)
+            for i, (k, s) in enumerate(zip(ks, shapes))}
+
+
+SHAPES = [(3, 5), (128,), (17, 129), (1,), (64, 64)]
+
+
+def test_flatten_roundtrip():
+    tree = make_tree(jax.random.PRNGKey(0), SHAPES)
+    fl = TreeFlattener(tree)
+    flat = fl.flatten(tree)
+    assert flat.shape[0] % fl.chunk == 0
+    out = fl.unflatten(flat)
+    for k in tree:
+        np.testing.assert_array_equal(out[k], tree[k])
+
+
+def test_flatten_mixed_dtypes_roundtrip():
+    tree = {"a": jnp.ones((5, 7), jnp.bfloat16), "b": jnp.ones((3,), jnp.float32)}
+    fl = TreeFlattener(tree)
+    out = fl.unflatten(fl.flatten(tree))
+    assert out["a"].dtype == jnp.bfloat16
+    assert out["b"].dtype == jnp.float32
+
+
+def test_per_tensor_sumsq():
+    tree = make_tree(jax.random.PRNGKey(1), SHAPES)
+    fl = TreeFlattener(tree)
+    sumsq = fl.per_tensor_sumsq(fl.flatten(tree))
+    expect = [float(jnp.sum(tree[f"p{i}"] ** 2)) for i in range(len(SHAPES))]
+    np.testing.assert_allclose(np.asarray(sumsq), expect, rtol=1e-5)
+
+
+def test_multi_tensor_scale():
+    tree = make_tree(jax.random.PRNGKey(2), SHAPES)
+    fl = TreeFlattener(tree)
+    flat = fl.flatten(tree)
+    out, flag = multi_tensor_scale(flat, 0.25)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(flat) * 0.25,
+                               rtol=1e-6)
+    assert int(flag) == 0
+
+
+def test_multi_tensor_scale_overflow_flag():
+    tree = {"a": jnp.array([1.0, jnp.inf] + [0.0] * 126)}
+    fl = TreeFlattener(tree)
+    _, flag = multi_tensor_scale(fl.flatten(tree), 1.0)
+    assert int(flag) == 1
+    tree = {"a": jnp.array([1.0, jnp.nan] + [0.0] * 126)}
+    _, flag = multi_tensor_scale(TreeFlattener(tree).flatten(tree), 1.0)
+    assert int(flag) == 1
+
+
+def test_multi_tensor_axpby():
+    t1 = make_tree(jax.random.PRNGKey(3), SHAPES)
+    t2 = make_tree(jax.random.PRNGKey(4), SHAPES)
+    fl = TreeFlattener(t1)
+    x, y = fl.flatten(t1), fl.flatten(t2)
+    out, flag = multi_tensor_axpby(x, y, 2.0, -0.5)
+    np.testing.assert_allclose(np.asarray(out),
+                               2.0 * np.asarray(x) - 0.5 * np.asarray(y),
+                               rtol=1e-6)
+    assert int(flag) == 0
+
+
+def test_multi_tensor_l2norm():
+    tree = make_tree(jax.random.PRNGKey(5), SHAPES)
+    fl = TreeFlattener(tree)
+    flat = fl.flatten(tree)
+    norm = multi_tensor_l2norm(flat)
+    np.testing.assert_allclose(float(norm),
+                               float(jnp.sqrt(jnp.sum(flat ** 2))), rtol=1e-5)
+
+
+def test_scale_kernel_jits():
+    tree = make_tree(jax.random.PRNGKey(6), [(256,)])
+    fl = TreeFlattener(tree)
+    f = jax.jit(lambda x: multi_tensor_scale(x, 2.0))
+    out, flag = f(fl.flatten(tree))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(fl.flatten(tree)) * 2.0, rtol=1e-6)

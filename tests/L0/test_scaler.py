@@ -1,0 +1,95 @@
+"""Loss-scaler semantics tests — mirrors the scale-update policy asserted by
+the reference suite (scaler.py:206-226 semantics; tests/L0/run_amp)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.amp import scaler as sc
+
+
+def test_static_scale_constant():
+    s = sc.init(128.0)
+    assert float(s.loss_scale) == 128.0
+    s2 = sc.update(s, jnp.asarray(False))
+    assert float(s2.loss_scale) == 128.0  # static never changes
+
+
+def test_dynamic_backoff_on_overflow():
+    s = sc.init("dynamic")
+    assert float(s.loss_scale) == 2.0 ** 16
+    s = sc.update(s, jnp.asarray(False))
+    assert float(s.loss_scale) == 2.0 ** 15
+    s = sc.update(s, jnp.asarray(False))
+    assert float(s.loss_scale) == 2.0 ** 14
+
+
+def test_dynamic_growth_after_window():
+    s = sc.init("dynamic", init_scale=2.0, scale_window=3)
+    for _ in range(2):
+        s = sc.update(s, jnp.asarray(True))
+        assert float(s.loss_scale) == 2.0
+    s = sc.update(s, jnp.asarray(True))   # 3rd clean step -> double
+    assert float(s.loss_scale) == 4.0
+    assert int(s.unskipped) == 0          # window resets
+
+
+def test_min_max_bounds():
+    s = sc.init("dynamic", init_scale=2.0, min_loss_scale=1.0)
+    for _ in range(5):
+        s = sc.update(s, jnp.asarray(False))
+    assert float(s.loss_scale) == 1.0     # clamped at min
+    s = sc.init("dynamic", init_scale=2.0 ** 24, scale_window=1)
+    s = sc.update(s, jnp.asarray(True))
+    assert float(s.loss_scale) == 2.0 ** 24  # clamped at max
+
+
+def test_unscale_and_finite():
+    s = sc.init(4.0)
+    grads = {"w": jnp.ones((4,)) * 8.0, "b": jnp.ones((2,)) * 4.0}
+    out, finite = sc.unscale(s, grads)
+    assert bool(finite)
+    np.testing.assert_allclose(out["w"], 2.0)
+    np.testing.assert_allclose(out["b"], 1.0)
+
+    bad = {"w": jnp.array([1.0, jnp.inf]), "b": jnp.ones((2,))}
+    _, finite = sc.unscale(s, bad)
+    assert not bool(finite)
+
+
+def test_unscale_with_stashed_accumulation():
+    s = sc.init(2.0)
+    new = {"w": jnp.full((3,), 4.0)}
+    stash = {"w": jnp.full((3,), 1.0)}
+    out, finite = sc.unscale_with_stashed(s, new, stash)
+    np.testing.assert_allclose(out["w"], 3.0)  # 1 + 4/2
+    assert bool(finite)
+
+
+def test_apply_if_finite_select():
+    new = {"w": jnp.ones((2,))}
+    old = {"w": jnp.zeros((2,))}
+    np.testing.assert_allclose(
+        sc.apply_if_finite(jnp.asarray(True), new, old)["w"], 1.0)
+    np.testing.assert_allclose(
+        sc.apply_if_finite(jnp.asarray(False), new, old)["w"], 0.0)
+
+
+def test_scaler_update_jits():
+    s = sc.init("dynamic")
+
+    @jax.jit
+    def step(state, finite):
+        return sc.update(state, finite)
+
+    s2 = step(s, jnp.asarray(False))
+    assert float(s2.loss_scale) == 2.0 ** 15
+
+
+def test_state_dict_roundtrip():
+    s = sc.init("dynamic")
+    s = sc.update(s, jnp.asarray(False))
+    d = sc.state_dict(s)
+    s2 = sc.load_state_dict(d)
+    assert float(s2.loss_scale) == float(s.loss_scale)
+    assert int(s2.unskipped) == int(s.unskipped)
