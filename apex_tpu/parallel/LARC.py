@@ -1,0 +1,95 @@
+"""LARC — Layer-wise Adaptive Rate Clipping/Scaling.
+
+Re-design of ``apex.parallel.LARC`` (``apex/parallel/LARC.py:5-107``): wraps
+any apex_tpu fused optimizer and rescales each parameter's gradient by an
+adaptive local LR before delegating — the reference's "implemented by
+rescaling grads" trick (``LARC.py:78-107``), which keeps the wrapped
+optimizer oblivious.
+
+Per parameter (``LARC.py:84-106``):
+  ``adaptive_lr = trust_coefficient * ||p|| / (||g|| + wd*||p|| + eps)``
+  - ``clip=True``  (default): grad *= min(adaptive_lr / lr, 1)
+  - ``clip=False``: grad *= adaptive_lr
+Weight decay is folded into the grad *before* the rescale (so the decay term
+is adaptively scaled too, exactly as the reference does by mutating
+``p.grad`` then zeroing the group's wd), and the wrapped optimizer's own
+decay is suppressed for the step.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizers._base import resolve
+
+
+class LARC:
+    """Optimizer wrapper.  Usage mirrors the reference::
+
+        opt = FusedSGD(lr=0.1, momentum=0.9)
+        opt = LARC(opt, trust_coefficient=0.02, clip=True)
+        state = opt.init(params); params, state = opt.step(state, grads, params)
+    """
+
+    def __init__(self, optimizer, trust_coefficient=0.02, clip=True, eps=1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    def __getattr__(self, name):  # delegate hyperparams (lr, etc.)
+        return getattr(self.optim, name)
+
+    def init(self, params):
+        return self.optim.init(params)
+
+    @contextlib.contextmanager
+    def _suppress_inner_wd(self):
+        """The reference zeroes ``group['weight_decay']`` while stepping
+        (LARC.py:95-103) because decay was already folded into the grads."""
+        wd = getattr(self.optim, "weight_decay", 0.0)
+        self.optim.weight_decay = 0.0
+        try:
+            yield wd
+        finally:
+            self.optim.weight_decay = wd
+
+    def _adapt(self, grads, params, lr, wd):
+        def leaf(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+            adaptive_lr = (self.trust_coefficient * p_norm
+                           / (g_norm + p_norm * wd + self.eps))
+            if self.clip:
+                scale = jnp.minimum(
+                    adaptive_lr / jnp.maximum(lr, 1e-30), 1.0)
+            else:
+                scale = adaptive_lr
+            adapted = (g32 + wd * p32) * scale
+            # zero-norm params or grads leave the grad fully untouched — no
+            # decay fold either (the reference's `if param_norm != 0 and
+            # grad_norm != 0` guard skips the whole block)
+            ok = (p_norm > 0) & (g_norm > 0)
+            return jnp.where(ok, adapted, g32).astype(g.dtype)
+
+        return jax.tree_util.tree_map(leaf, grads, params)
+
+    def step(self, state, grads, params, *, lr=None, scale=1.0, **kw):
+        # the wrapped optimizer increments count *before* resolving schedules
+        # (see FusedSGD.step), so clip against the lr this step will use
+        count = getattr(state, "count", 0) + 1
+        eff_lr = resolve(lr if lr is not None else self.optim.lr, count)
+        if not (isinstance(scale, (int, float)) and scale == 1.0):
+            # the reference LARC only ever sees unscaled grads (amp unscales
+            # before optimizer.step) — norms must be computed on real grads,
+            # so unscale here and hand the inner optimizer scale=1
+            inv = 1.0 / jnp.asarray(scale, jnp.float32)
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        with self._suppress_inner_wd() as wd:
+            grads = self._adapt(grads, params, eff_lr, wd)
+            return self.optim.step(state, grads, params, lr=lr, **kw)

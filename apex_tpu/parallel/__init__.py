@@ -1,9 +1,11 @@
 """Distributed training over a TPU device mesh (reference: ``apex/parallel``).
 
 The reference's NCCL bucket machinery maps onto SPMD: gradient allreduce is a
-``psum`` inside the jitted step, SyncBatchNorm's cross-rank Welford merge is an
-``all_gather`` over a mesh axis, process groups are mesh sub-axes.
+``psum`` inside the jitted step, SyncBatchNorm's cross-rank Welford merge is a
+``psum`` of (Σx, Σx², n) over a mesh axis, process groups are mesh sub-axes.
 """
+import copy
+
 from . import mesh
 from .mesh import (
     create_mesh,
@@ -16,3 +18,60 @@ from .mesh import (
     MODEL_AXIS,
     SEQ_AXIS,
 )
+from .distributed import DistributedDataParallel, Reducer, allreduce_tree
+from .sync_batchnorm import SyncBatchNorm, sync_batch_norm, batch_norm_stats
+from .LARC import LARC
+
+
+def convert_syncbn_model(module, process_group=None, channel_last=True):
+    """Recursively replace BatchNorm-like modules with ``SyncBatchNorm`` —
+    the analog of ``apex.parallel.convert_syncbn_model``
+    (``apex/parallel/__init__.py:21-56``).
+
+    Works over apex_tpu plain-module trees (objects holding submodules as
+    attributes / list / dict entries, e.g. ``apex_tpu.models``).  A module is
+    BatchNorm-like when its class name contains "BatchNorm" (but not "Sync")
+    and it carries the standard (num_features, eps, momentum, affine) config.
+    Returns a new tree; the input is not mutated.
+    """
+    cls_name = type(module).__name__
+    if ("BatchNorm" in cls_name and "Sync" not in cls_name
+            and hasattr(module, "num_features")):
+        return SyncBatchNorm(
+            module.num_features, eps=module.eps, momentum=module.momentum,
+            affine=getattr(module, "affine", True),
+            track_running_stats=getattr(module, "track_running_stats", True),
+            process_group=process_group, channel_last=channel_last)
+    if isinstance(module, tuple):
+        items = [convert_syncbn_model(m, process_group, channel_last)
+                 for m in module]
+        if hasattr(module, "_fields"):  # NamedTuple: positional construction
+            return type(module)(*items)
+        return type(module)(items)
+    if isinstance(module, list):
+        return type(module)(
+            convert_syncbn_model(m, process_group, channel_last)
+            for m in module)
+    if isinstance(module, dict):
+        return type(module)(
+            (k, convert_syncbn_model(v, process_group, channel_last))
+            for k, v in module.items())
+    # only descend into apex_tpu module objects — not arrays/arbitrary values
+    if type(module).__module__.startswith("apex_tpu") and hasattr(module, "__dict__"):
+        new = copy.copy(module)
+        for k, v in vars(module).items():
+            conv = convert_syncbn_model(v, process_group, channel_last)
+            if conv is not v:
+                setattr(new, k, conv)
+        return new
+    return module
+
+
+def create_syncbn_process_group(group_size):
+    """Mesh-based analog of ``create_syncbn_process_group``
+    (``apex/parallel/__init__.py:58-95``): returns a 2-D (data, group) mesh
+    whose ``group`` axis has size ``group_size``.  Pass
+    ``process_group=GROUP_AXIS`` to SyncBatchNorm *explicitly* — the default
+    (``None``) syncs over the whole world, which under this mesh would
+    include the data axis and defeat the grouping."""
+    return create_grouped_mesh(group_size)

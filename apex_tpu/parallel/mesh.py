@@ -37,7 +37,20 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
                            process_id=None, local_device_ids=None):
     """Multi-host bring-up — replaces ``apex.parallel.multiproc`` +
     ``torch.distributed.init_process_group`` (NCCL) with
-    ``jax.distributed.initialize``.  No-op for single-process runs."""
+    ``jax.distributed.initialize``.  No-op for single-process runs.
+
+    Arguments default from the ``APEX_TPU_*`` env set by
+    ``python -m apex_tpu.parallel.multiproc`` (jax itself does not read
+    num-processes/process-id from env), so a launched script can simply call
+    ``initialize_distributed()`` with no args.
+    """
+    import os
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("APEX_TPU_COORDINATOR_ADDRESS")
+    if num_processes is None and "APEX_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["APEX_TPU_NUM_PROCESSES"])
+    if process_id is None and "APEX_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["APEX_TPU_PROCESS_ID"])
     if num_processes is None or num_processes <= 1:
         return
     jax.distributed.initialize(
@@ -128,6 +141,32 @@ def current_mesh() -> Optional[Mesh]:
     except Exception:
         pass
     return None
+
+
+def axis_is_bound(axis_name) -> bool:
+    """True when ``axis_name`` (or every name in a tuple) is bound by an
+    enclosing shard_map/pmap trace.  Single source of truth for the
+    "mapped context or single-device?" decision used by the collectives
+    wrappers (distributed.allreduce_tree, sync_batchnorm).
+    """
+    names = (axis_name if isinstance(axis_name, (tuple, list))
+             else (axis_name,))
+    try:
+        from jax._src.core import get_axis_env
+        env = get_axis_env()
+        return all(env.axis_exists(n) for n in names)
+    except ImportError:  # pragma: no cover - older/newer jax layout
+        try:
+            for n in names:
+                jax.lax.axis_index(n)
+            return True
+        except NameError:
+            return False
+
+
+def bound_axes(*names) -> tuple:
+    """The subset of ``names`` currently bound (ordered as given)."""
+    return tuple(n for n in names if axis_is_bound(n))
 
 
 def axis_size(axis_name: str, mesh: Optional[Mesh] = None) -> int:

@@ -1,0 +1,62 @@
+"""Launcher — ``python -m apex_tpu.parallel.multiproc script.py [args...]``.
+
+Re-design of ``apex.parallel.multiproc`` (``apex/parallel/multiproc.py:1-35``),
+which spawned one Python process per visible GPU with RANK/WORLD_SIZE env.
+
+On TPU the execution model inverts: ONE process per host drives all local
+chips, and multi-host jobs set coordinator env vars consumed by
+``jax.distributed.initialize`` (see ``mesh.initialize_distributed``).  So this
+launcher execs the script once per *host slot* it is told about, defaulting to
+a single local process — its job is env bring-up, not process fan-out:
+
+  - single host (default):  exec script with JAX owning all local devices.
+  - ``--nnodes/--node_rank/--coordinator``: set the standard JAX cluster env
+    (COORDINATOR_ADDRESS etc.) then exec.
+
+Kept as a module-level CLI for command-line parity with
+``torch.distributed.launch``-style invocations in the reference's test
+scripts (``tests/distributed/*/run_rocm_distributed.sh``).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        "apex_tpu.parallel.multiproc",
+        description="launch a training script on this host's TPU devices")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--coordinator", type=str, default=None,
+                        help="host:port of process 0 (multi-host only)")
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.nnodes > 1:
+        if not args.coordinator:
+            parser.error("--coordinator required when --nnodes > 1")
+        # consumed by mesh.initialize_distributed() in the launched script
+        # (jax reads only the coordinator address from env, not process
+        # count/id — those must be passed to jax.distributed.initialize)
+        os.environ["APEX_TPU_COORDINATOR_ADDRESS"] = args.coordinator
+        os.environ["APEX_TPU_NUM_PROCESSES"] = str(args.nnodes)
+        os.environ["APEX_TPU_PROCESS_ID"] = str(args.node_rank)
+    else:
+        # single-node launch: clear stale cluster env from a previous
+        # multi-node shell so initialize_distributed() cannot dial a dead
+        # coordinator
+        for var in ("APEX_TPU_COORDINATOR_ADDRESS", "APEX_TPU_NUM_PROCESSES",
+                    "APEX_TPU_PROCESS_ID"):
+            os.environ.pop(var, None)
+
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()
