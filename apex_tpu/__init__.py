@@ -20,5 +20,6 @@ from . import optimizers
 from . import normalization
 from . import parallel
 from . import mlp
+from . import models
 
 __version__ = "0.1.0"

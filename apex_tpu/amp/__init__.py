@@ -29,6 +29,7 @@ from .amp import (
 from .frontend import (
     initialize,
     scale_loss,
+    amp_step,
     state_dict,
     load_state_dict,
     AmpState,

@@ -1,0 +1,17 @@
+"""Model zoo used by the examples, benchmarks and the graft entry.
+
+The reference (jithunnair-amd/apex) ships models only inside examples/tests
+(ResNet-50 in ``examples/imagenet/main_amp.py``, DCGAN in ``examples/dcgan``,
+toy MLPs in ``tests/L0``); its contrib MHA targets transformer encoders.
+This package holds TPU-native functional implementations of those workloads
+(transformer today; ResNet/DCGAN as they land) so the BASELINE configs are
+runnable end-to-end without external model code.
+"""
+from .transformer import (TransformerConfig, transformer_init,
+                          transformer_apply, transformer_loss,
+                          transformer_pspecs, bert_large_config)
+
+__all__ = [
+    "TransformerConfig", "transformer_init", "transformer_apply",
+    "transformer_loss", "transformer_pspecs", "bert_large_config",
+]

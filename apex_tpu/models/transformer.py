@@ -1,0 +1,215 @@
+"""BERT-style transformer encoder LM — the flagship model for the BERT-large
+FusedLAMB pretrain benchmark (BASELINE config[3]; the workload behind the
+reference's "BERT in 76 minutes" LAMB citation, ``apex/optimizers/fused_lamb.py:32``)
+and for the contrib multihead-attn perf harness
+(``apex/contrib/examples/multihead_attn/perf_test_multihead_attn.py``).
+
+TPU-first design decisions:
+  - pure functional ``init``/``apply`` over a param pytree; layers are
+    *stacked* (leading ``num_layers`` dim) and iterated with ``lax.scan`` so
+    compile time is O(1) in depth and pipeline/tensor shardings are a
+    PartitionSpec away;
+  - every matmul is laid out for the MXU (model dims multiples of 128,
+    bf16 activations under amp);
+  - ``transformer_pspecs`` gives a Megatron-style tensor-parallel sharding
+    (QKV/ff1 column-split over heads, out-proj/ff2 row-split) expressed as
+    PartitionSpecs — XLA inserts the psums; no hand-written collectives;
+  - attention is the fused-by-XLA jnp reference path (``_attention``); it is
+    the correctness oracle the contrib fast-attention kernel must match.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..normalization.fused_layer_norm import fused_layer_norm_affine
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    max_len: int = 512
+    num_layers: int = 4
+    d_model: int = 256
+    num_heads: int = 4
+    d_ff: int = 1024
+    dropout: float = 0.0          # inference/bench default; train passes rng
+    causal: bool = False          # BERT-style bidirectional by default
+    dtype: Any = jnp.float32      # activation dtype (amp casts params)
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.num_heads == 0
+        return self.d_model // self.num_heads
+
+
+def bert_large_config(**overrides) -> TransformerConfig:
+    base = dict(vocab_size=30592, max_len=512, num_layers=24, d_model=1024,
+                num_heads=16, d_ff=4096)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def _dense_init(key, shape, scale=0.02):
+    return scale * jax.random.normal(key, shape, jnp.float32)
+
+
+def transformer_init(key, cfg: TransformerConfig):
+    """Param pytree.  Per-layer weights are stacked on a leading L axis."""
+    keys = jax.random.split(key, 8)
+    L, D, F, V = cfg.num_layers, cfg.d_model, cfg.d_ff, cfg.vocab_size
+    params = {
+        "embed": {
+            "tok": _dense_init(keys[0], (V, D)),
+            "pos": _dense_init(keys[1], (cfg.max_len, D)),
+            "ln_g": jnp.ones((D,), jnp.float32),
+            "ln_b": jnp.zeros((D,), jnp.float32),
+        },
+        "layers": {
+            "wqkv": _dense_init(keys[2], (L, D, 3 * D)),
+            "bqkv": jnp.zeros((L, 3 * D), jnp.float32),
+            "wo": _dense_init(keys[3], (L, D, D)),
+            "bo": jnp.zeros((L, D), jnp.float32),
+            "ln1_g": jnp.ones((L, D), jnp.float32),
+            "ln1_b": jnp.zeros((L, D), jnp.float32),
+            "w1": _dense_init(keys[4], (L, D, F)),
+            "b1": jnp.zeros((L, F), jnp.float32),
+            "w2": _dense_init(keys[5], (L, F, D)),
+            "b2": jnp.zeros((L, D), jnp.float32),
+            "ln2_g": jnp.ones((L, D), jnp.float32),
+            "ln2_b": jnp.zeros((L, D), jnp.float32),
+        },
+        "head": {
+            "ln_g": jnp.ones((D,), jnp.float32),
+            "ln_b": jnp.zeros((D,), jnp.float32),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["head"]["out"] = _dense_init(keys[6], (D, V))
+    return params
+
+
+def transformer_pspecs(cfg: TransformerConfig, *, dp="data", tp="model"):
+    """Megatron-style tensor-parallel PartitionSpec tree matching
+    ``transformer_init``'s structure.  Column-parallel: QKV / ff1 (shard the
+    output feature dim over ``tp``); row-parallel: out-proj / ff2 (shard the
+    input dim).  Embeddings shard the vocab dim; norms replicate.
+    XLA derives the all-reduces from these specs (scaling-book recipe)."""
+    del dp  # params are replicated over the data axis
+    head = {"ln_g": P(), "ln_b": P()}
+    if not cfg.tie_embeddings:
+        head["out"] = P(None, tp)
+    return {
+        "embed": {"tok": P(tp, None), "pos": P(), "ln_g": P(), "ln_b": P()},
+        "layers": {
+            "wqkv": P(None, None, tp), "bqkv": P(None, tp),
+            "wo": P(None, tp, None), "bo": P(None, None),
+            "ln1_g": P(None, None), "ln1_b": P(None, None),
+            "w1": P(None, None, tp), "b1": P(None, tp),
+            "w2": P(None, tp, None), "b2": P(None, None),
+            "ln2_g": P(None, None), "ln2_b": P(None, None),
+        },
+        "head": head,
+    }
+
+
+def _attention(x, wqkv, bqkv, wo, bo, cfg: TransformerConfig, mask,
+               dropout_rng=None):
+    """Self-attention reference path (jnp; XLA fuses).  The contrib fast
+    Pallas kernel slots in behind the same signature."""
+    B, S, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    qkv = jnp.einsum("bsd,de->bse", x, wqkv.astype(x.dtype)) + bqkv.astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(hd, x.dtype))
+    if cfg.causal:
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(causal[None, None], scores, -jnp.inf)
+    if mask is not None:  # key padding mask: (B, S) True = keep
+        scores = jnp.where(mask[:, None, None, :], scores,
+                           jnp.asarray(-1e9, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    if dropout_rng is not None and cfg.dropout > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - cfg.dropout,
+                                    probs.shape)
+        probs = probs * keep.astype(probs.dtype) / (1.0 - cfg.dropout)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+    return jnp.einsum("bsd,de->bse", ctx, wo.astype(x.dtype)) + bo.astype(x.dtype)
+
+
+def _layer(x, lp, cfg: TransformerConfig, mask, dropout_rng):
+    """Pre-LN transformer block (the contrib norm-add layout,
+    ``apex/contrib/multihead_attn/self_multihead_attn.py`` norm-add variant)."""
+    dt = x.dtype
+    h = fused_layer_norm_affine(x, lp["ln1_g"].astype(dt), lp["ln1_b"].astype(dt),
+                                (cfg.d_model,))
+    r1 = None
+    if dropout_rng is not None:
+        dropout_rng, r1 = jax.random.split(dropout_rng)
+    x = x + _attention(h, lp["wqkv"], lp["bqkv"], lp["wo"], lp["bo"], cfg,
+                       mask, r1)
+    h = fused_layer_norm_affine(x, lp["ln2_g"].astype(dt), lp["ln2_b"].astype(dt),
+                                (cfg.d_model,))
+    h = jnp.einsum("bsd,df->bsf", h, lp["w1"].astype(dt)) + lp["b1"].astype(dt)
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("bsf,fd->bsd", h, lp["w2"].astype(dt)) + lp["b2"].astype(dt)
+    return x + h
+
+
+def transformer_apply(params, tokens, cfg: TransformerConfig, *,
+                      mask=None, dropout_rng=None):
+    """tokens (B, S) int32 -> logits (B, S, V).  Layers run under lax.scan
+    over the stacked L axis."""
+    emb = params["embed"]
+    dt = cfg.dtype
+    x = emb["tok"][tokens].astype(dt) + emb["pos"][: tokens.shape[1]][None].astype(dt)
+    x = fused_layer_norm_affine(x, emb["ln_g"].astype(dt),
+                                emb["ln_b"].astype(dt), (cfg.d_model,))
+
+    n_layers = params["layers"]["wqkv"].shape[0]
+    if dropout_rng is not None:
+        layer_rngs = jax.random.split(dropout_rng, n_layers)
+    else:
+        layer_rngs = None
+
+    def body(carry, layer_in):
+        lp = layer_in[0] if layer_rngs is not None else layer_in
+        rng = layer_in[1] if layer_rngs is not None else None
+        return _layer(carry, lp, cfg, mask, rng), None
+
+    xs = (params["layers"], layer_rngs) if layer_rngs is not None \
+        else params["layers"]
+    x, _ = jax.lax.scan(body, x, xs)
+
+    hd = params["head"]
+    x = fused_layer_norm_affine(x, hd["ln_g"].astype(dt), hd["ln_b"].astype(dt),
+                                (cfg.d_model,))
+    w_out = (emb["tok"].T if cfg.tie_embeddings else hd["out"]).astype(dt)
+    return jnp.einsum("bsd,dv->bsv", x, w_out)
+
+
+def transformer_loss(params, batch, cfg: TransformerConfig, *,
+                     dropout_rng=None):
+    """Masked-LM style cross-entropy.  batch: dict(tokens (B,S) int32,
+    targets (B,S) int32, weights optional (B,S) f32)."""
+    logits = transformer_apply(params, batch["tokens"], cfg,
+                               mask=batch.get("mask"),
+                               dropout_rng=dropout_rng).astype(jnp.float32)
+    tgt = batch["targets"]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    nll = lse - gold
+    w = batch.get("weights")
+    if w is None:
+        return nll.mean()
+    return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)

@@ -160,31 +160,40 @@ def multi_tensor_axpby(flat_x, flat_y, a, b, out_dtype=None):
 
 
 # --------------------------------------------------------------------------
-# multi_tensor_l2norm (multi_tensor_l2norm_kernel.cu two-stage reduction):
-# stage 1 in Pallas (per-chunk partials), stage 2 is a tiny XLA reduce.
+# multi_tensor_l2norm (multi_tensor_l2norm_kernel.cu): the CUDA two-stage
+# reduction collapses into sequential accumulation over the TPU grid.
 # --------------------------------------------------------------------------
 
 def multi_tensor_l2norm(flat_in):
     total = flat_in.shape[0]
+    if total == 0:
+        return jnp.zeros((), jnp.float32)
     rows = total // LANE
     br = _block_rows(total)
     grid = rows // br
 
-    def kernel(x_ref, part_ref):
-        x = x_ref[:].astype(jnp.float32)
-        part_ref[0, 0] = jnp.sum(x * x)
+    # TPU grid steps run sequentially, so the sum accumulates into one (1, 1)
+    # SMEM cell (the two-stage partials of multi_tensor_l2norm_kernel.cu:197
+    # collapse into sequential accumulation).
+    def kernel(x_ref, acc_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            acc_ref[0, 0] = 0.0
 
-    partials = pl.pallas_call(
+        x = x_ref[:].astype(jnp.float32)
+        acc_ref[0, 0] += jnp.sum(x * x)
+
+    sumsq = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[pl.BlockSpec((br, LANE), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0),
                                memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((grid, 1), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
         interpret=_interpret(),
     )(flat_in.reshape(rows, LANE))
-    return jnp.sqrt(jnp.sum(partials))
+    return jnp.sqrt(sumsq[0, 0])
 
 
 # --------------------------------------------------------------------------

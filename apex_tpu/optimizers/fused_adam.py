@@ -43,8 +43,11 @@ class FusedAdam(FusedOptimizer):
     def init(self, params) -> FusedAdamState:
         if self.impl == "fused":
             fl = self.flattener_for(params)
-            zeros = jnp.zeros((fl.total,), jnp.float32)
-            return FusedAdamState(jnp.zeros((), jnp.int32), zeros, zeros)
+            # distinct buffers: a shared array donated twice (jit
+            # donate_argnums) is an aliasing error on the TPU backend
+            return FusedAdamState(jnp.zeros((), jnp.int32),
+                                  jnp.zeros((fl.total,), jnp.float32),
+                                  jnp.zeros((fl.total,), jnp.float32))
         z = tree_zeros_f32(params)
         return FusedAdamState(jnp.zeros((), jnp.int32), z,
                               tree_zeros_f32(params))

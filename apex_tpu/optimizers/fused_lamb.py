@@ -45,8 +45,11 @@ class FusedLAMB(FusedOptimizer):
     def init(self, params) -> FusedLAMBState:
         if self.impl == "fused":
             fl = self.flattener_for(params)
-            zeros = jnp.zeros((fl.total,), jnp.float32)
-            return FusedLAMBState(jnp.zeros((), jnp.int32), zeros, zeros)
+            # m and v must be distinct buffers: a shared array donated twice
+            # (jit donate_argnums) is an aliasing error on the TPU backend
+            return FusedLAMBState(jnp.zeros((), jnp.int32),
+                                  jnp.zeros((fl.total,), jnp.float32),
+                                  jnp.zeros((fl.total,), jnp.float32))
         return FusedLAMBState(jnp.zeros((), jnp.int32), tree_zeros_f32(params),
                               tree_zeros_f32(params))
 

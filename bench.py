@@ -1,0 +1,107 @@
+"""Driver benchmark: prints ONE JSON line.
+
+Metric (per BASELINE.json): FusedLAMB step-time on a BERT-large-sized
+parameter set (~334M params) — the ``multi_tensor_lamb`` hot path
+(SURVEY §3.4).  Baseline = the equivalent optax recipe
+(``clip_by_global_norm + lamb``), i.e. what a JAX user would run without
+apex_tpu.  ``vs_baseline`` = baseline_ms / our_ms, >1.0 means faster.
+
+Timing uses the slope method — (T(n2) - T(n1)) / (n2 - n1) with a host
+readback as the sync point — because ``block_until_ready`` does not actually
+block through remote-tunnel TPU backends.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import bert_large_config, transformer_init
+from apex_tpu.optimizers import FusedLAMB
+
+
+def _sync(tree):
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return float(leaf.reshape(-1)[0])
+
+
+def slope_time_ms(stepfn, state, params, grads, n1=3, n2=13):
+    def run(n, state, params):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, state = stepfn(state, grads, params)
+        _sync(params)
+        return time.perf_counter() - t0, state, params
+
+    t1, state, params = run(n1, state, params)
+    t2, state, params = run(n2, state, params)
+    return (t2 - t1) / (n2 - n1) * 1e3
+
+
+def time_apex(impl, make_params, grads):
+    opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=1.0, impl=impl)
+    params = make_params()
+    state = opt.init(params)
+    stepfn = jax.jit(lambda s, g, p: opt.step(s, g, p), donate_argnums=(0, 2))
+
+    params, state = stepfn(state, grads, params)  # compile
+    _sync(params)
+    return slope_time_ms(stepfn, state, params, grads)
+
+
+def time_optax(make_params, grads):
+    import optax
+    ox = optax.chain(optax.clip_by_global_norm(1.0),
+                     optax.lamb(1e-3, weight_decay=0.01))
+    params = make_params()
+    state = jax.jit(ox.init)(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 2))
+    def jitted(s, g, p):
+        u, s2 = ox.update(g, s, p)
+        return s2, optax.apply_updates(p, u)
+
+    def stepfn(s, g, p):
+        s2, p2 = jitted(s, g, p)
+        return p2, s2
+
+    params, state = stepfn(state, grads, params)  # compile
+    _sync(params)
+    return slope_time_ms(stepfn, state, params, grads)
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = bert_large_config() if on_tpu else bert_large_config(
+        num_layers=2, d_model=256, d_ff=1024, vocab_size=4096, max_len=128,
+        num_heads=4)
+    make_params = jax.jit(lambda: transformer_init(jax.random.PRNGKey(0), cfg))
+    params = make_params()
+    grads = jax.jit(lambda p: jax.tree_util.tree_map(
+        lambda x: 0.01 * jnp.ones_like(x), p))(params)
+    n_params = int(sum(p.size for p in jax.tree_util.tree_leaves(params)))
+    del params
+
+    xla_ms = time_apex("xla", make_params, grads)
+    fused_ms = time_apex("fused", make_params, grads)
+    base_ms = time_optax(make_params, grads)
+    best_ms = min(xla_ms, fused_ms)
+
+    print(json.dumps({
+        "metric": "fused_lamb_step_ms_bert_large",
+        "value": round(best_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(base_ms / best_ms, 3),
+        "detail": {"optax_baseline_ms": round(base_ms, 3),
+                   "xla_impl_ms": round(xla_ms, 3),
+                   "pallas_flat_impl_ms": round(fused_ms, 3),
+                   "backend": jax.default_backend(),
+                   "n_params": n_params},
+    }))
+
+
+if __name__ == "__main__":
+    main()
