@@ -21,5 +21,6 @@ from . import normalization
 from . import parallel
 from . import mlp
 from . import models
+from . import contrib
 
 __version__ = "0.1.0"

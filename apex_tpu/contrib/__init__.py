@@ -1,0 +1,10 @@
+"""Opt-in extensions (reference: ``apex/contrib``).
+
+Unlike the reference — where each contrib module hard-requires its own CUDA
+extension built with a setup.py flag (``setup.py:242-476``) — every apex_tpu
+contrib component ships a pure-XLA fallback and an optional Pallas fast path
+selected at call time.
+"""
+from . import xentropy
+
+__all__ = ["xentropy"]

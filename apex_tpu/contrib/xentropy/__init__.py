@@ -1,0 +1,4 @@
+"""Label-smoothing softmax cross-entropy (reference: ``apex/contrib/xentropy``)."""
+from .softmax_xentropy import SoftmaxCrossEntropyLoss, softmax_xentropy_loss
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_xentropy_loss"]

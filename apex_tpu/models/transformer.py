@@ -199,16 +199,20 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
 
 
 def transformer_loss(params, batch, cfg: TransformerConfig, *,
-                     dropout_rng=None):
-    """Masked-LM style cross-entropy.  batch: dict(tokens (B,S) int32,
-    targets (B,S) int32, weights optional (B,S) f32)."""
+                     dropout_rng=None, smoothing=0.0):
+    """Masked-LM style cross-entropy via the contrib fused xentropy kernel.
+    batch: dict(tokens (B,S) int32, targets (B,S) int32,
+    weights optional (B,S) f32)."""
+    from ..contrib.xentropy import softmax_xentropy_loss
     logits = transformer_apply(params, batch["tokens"], cfg,
                                mask=batch.get("mask"),
-                               dropout_rng=dropout_rng).astype(jnp.float32)
-    tgt = batch["targets"]
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
-    nll = lse - gold
+                               dropout_rng=dropout_rng)
+    B, S, V = logits.shape
+    # padding_idx=-1: padding is expressed through ``weights``, and vocab id 0
+    # is a legitimate target here (unlike the reference's seq2seq pad=0)
+    nll = softmax_xentropy_loss(logits.reshape(B * S, V),
+                                batch["targets"].reshape(B * S),
+                                smoothing, -1).reshape(B, S)
     w = batch.get("weights")
     if w is None:
         return nll.mean()
