@@ -6,5 +6,6 @@ contrib component ships a pure-XLA fallback and an optional Pallas fast path
 selected at call time.
 """
 from . import xentropy
+from . import multihead_attn
 
-__all__ = ["xentropy"]
+__all__ = ["xentropy", "multihead_attn"]

@@ -1,0 +1,17 @@
+"""Transformer multihead attention (reference: ``apex/contrib/multihead_attn``).
+
+``impl='fast'`` = Pallas flash attention (blockwise online softmax, O(S)
+memory, dropout-mask regeneration in backward); ``impl='default'`` = the
+pure-jnp reference path — the same fast/default split the reference offers
+(CUDA monolith vs pure torch, ``self_multihead_attn.py:92-99``).
+"""
+from .modules import SelfMultiheadAttn, EncdecMultiheadAttn
+from .functional import self_attn_func, encdec_attn_func
+from .flash import flash_attention
+from .mask_softmax_dropout import fast_mask_softmax_dropout_func
+
+__all__ = [
+    "SelfMultiheadAttn", "EncdecMultiheadAttn",
+    "self_attn_func", "encdec_attn_func",
+    "flash_attention", "fast_mask_softmax_dropout_func",
+]

@@ -1,0 +1,430 @@
+"""Flash-attention-style fused attention kernels (Pallas TPU).
+
+TPU re-design of the reference's monolithic MHA CUDA extensions
+(``apex/contrib/csrc/multihead_attn/*`` — QKV GEMM → strided-batched QK^T →
+fused (masked) softmax+dropout → PV, ~6.5k LoC CUDA).  The CUDA code
+materializes the (Sq, Sk) score matrix in HBM; on TPU we go blockwise with
+online-softmax rescaling so scores never leave VMEM (O(S) memory), which is
+both the perf win and what makes a later ring/sequence-parallel variant an
+extension rather than a rewrite (SURVEY §5.7).
+
+Semantics parity with the CUDA kernels:
+  - softmax over keys, THEN dropout on the probabilities (the denominator
+    sees no dropout) — ``self_multihead_attn_func.py:72-76``;
+  - dropout mask regeneration in backward from the same counter-based seeds
+    (the CUDA side saves the mask; the TPU side re-derives it — cheaper than
+    an (Sq, Sk) HBM roundtrip);
+  - additive bias supports key-padding masks (B, 1, Sk), additive masks, and
+    full (1|B, Sq, Sk) score masks; ``causal`` covers the time-mask path.
+
+forward  : out, lse   (lse = log-sum-exp per query row, the saved residual)
+backward : recompute-based (flash bwd), one kernel for dq, one for dk/dv.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+NEG_INF = -1e30
+
+
+from ...utils.pallas import interpret_mode as _interpret
+
+
+def _dropout_keep(seed, bh, row0, col0, shape, rate):
+    """Counter-based dropout keep-mask over *global* (head, row, col)
+    coordinates — squirrel3-style integer hash in plain jnp, so forward and
+    both backward kernels regenerate bit-identical masks regardless of their
+    grid shapes, on every backend (the CUDA side instead saves the mask to
+    HBM; a hash is cheaper than the round-trip).  Uniformity is ample for
+    dropout."""
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    cols = col0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         + cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+         + (seed + bh * jnp.int32(7919)).astype(jnp.uint32)
+         * jnp.uint32(0xC2B2AE3D))
+    x = x * jnp.uint32(0xB5297A4D)
+    x = x ^ (x >> jnp.uint32(8))
+    x = x + jnp.uint32(0x68E31DA4)
+    x = x ^ (x << jnp.uint32(8))
+    x = x * jnp.uint32(0x1B56C4E9)
+    x = x ^ (x >> jnp.uint32(8))
+    threshold = jnp.uint32(int(rate * (2 ** 32)))
+    return (x >= threshold).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, *, bq, bk, causal, dropout_rate,
+                heads):
+    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        # whole block above the diagonal: nothing to do
+        run = (ki * bk) <= (qi * bq + bq - 1)
+
+    @pl.when(run)
+    def _():
+        # matmuls take the native dtype (bf16 rides the MXU at full rate)
+        # and accumulate in f32 via preferred_element_type
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s + bias_ref[0].astype(jnp.float32)               # (bq|1, bk)
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+
+        m_old = m_ref[:, 0]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=1))
+        scale = jnp.exp(m_old - m_new)
+        p = jnp.exp(s - m_new[:, None])                       # (bq, bk)
+        l_ref[:, 0] = l_ref[:, 0] * scale + jnp.sum(p, axis=1)
+        m_ref[:, 0] = m_new
+
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], bh, qi * bq, ki * bk, p.shape,
+                                 dropout_rate)
+            p = p * keep / (1.0 - dropout_rate)
+
+        v = v_ref[0]                                          # (bk, d)
+        acc_ref[:] = acc_ref[:] * scale[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_ref[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        # a row whose max never rose above the mask floor saw only masked
+        # keys: emit zeros (constant NEG_INF bias cancels in the online
+        # softmax, so without this test pad content would leak through)
+        dead = m_ref[:, 0] <= NEG_INF / 2
+        o = acc_ref[:] / safe_l[:, None]
+        o_ref[0] = jnp.where(dead[:, None], 0.0, o).astype(o_ref.dtype)
+        # dead rows store +NEG_INF-magnitude lse so the backward's
+        # exp(s - lse) underflows to 0 (zero grads for dead rows)
+        lse_ref[0, :, 0] = jnp.where(dead, -NEG_INF,
+                                     m_ref[:, 0] + jnp.log(safe_l))
+
+
+def _bias_spec(bias, heads, bq, bk):
+    """BlockSpec for an additive bias of shape (1|B, 1|Sq, Sk)."""
+    b_bcast = bias.shape[0] == 1
+    q_bcast = bias.shape[1] == 1
+
+    def index_map(bh, qi, ki):
+        return (0 if b_bcast else bh // heads, 0 if q_bcast else qi, ki)
+
+    return pl.BlockSpec((1, 1 if q_bcast else bq, bk), index_map,
+                        memory_space=pltpu.VMEM)
+
+
+def _pad_inputs(q, k, v, bias, do=None, bq=DEFAULT_BLOCK_Q,
+                bk=DEFAULT_BLOCK_K):
+    """Pad ragged Sq/Sk up to block multiples.  Padded key columns carry
+    NEG_INF bias (zero attention weight); padded query rows are sliced off
+    by the caller.  Returns (q, k, v, bias, do, orig_sq, orig_sk)."""
+    Sq, Sk = q.shape[1], k.shape[1]
+    bq = min(bq, Sq)
+    bk = min(bk, Sk)
+    sq_pad = -Sq % bq
+    sk_pad = -Sk % bk
+    if sq_pad:
+        q = jnp.pad(q, ((0, 0), (0, sq_pad), (0, 0)))
+        if do is not None:
+            do = jnp.pad(do, ((0, 0), (0, sq_pad), (0, 0)))
+        if bias.shape[1] != 1:
+            bias = jnp.pad(bias, ((0, 0), (0, sq_pad), (0, 0)))
+    if sk_pad:
+        k = jnp.pad(k, ((0, 0), (0, sk_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, sk_pad), (0, 0)))
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, sk_pad)),
+                       constant_values=NEG_INF)
+    return q, k, v, bias, do, Sq, Sk
+
+
+def _flash_fwd(q, k, v, bias, causal, dropout_rate, seed, heads,
+               bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K):
+    """q (BH, Sq, D), k/v (BH, Sk, D), bias (1|B, 1|Sq, Sk) f32.
+    Returns out (BH, Sq, D), lse (BH, Sq, 1) f32."""
+    q, k, v, bias, _, orig_sq, _ = _pad_inputs(q, k, v, bias, bq=bq, bk=bk)
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq = min(bq, Sq)
+    bk = min(bk, Sk)
+    grid = (BH, (Sq + bq - 1) // bq, (Sk + bk - 1) // bk)
+    seed_arr = jnp.reshape(jnp.asarray(seed, jnp.int32), (1,))
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, bq=bq, bk=bk, causal=causal,
+                          dropout_rate=dropout_rate, heads=heads),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),           # seed
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            _bias_spec(bias, heads, bq, bk),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=_interpret(),
+    )(seed_arr, q, k, v, bias)
+    return out[:, :orig_sq], lse[:, :orig_sq]
+
+
+# ---------------------------------------------------------------------------
+# backward (recompute): dq kernel (grid over q), dkv kernel (grid over k)
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q_ref, k_ref, bias_ref, lse_ref, qi, ki, bq, bk, causal):
+    s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s + bias_ref[0].astype(jnp.float32)
+    if causal:
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols <= rows, s, NEG_INF)
+    return jnp.exp(s - lse_ref[0, :, 0][:, None])             # (bq, bk)
+
+
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_acc, *, bq, bk, causal, dropout_rate,
+                   heads):
+    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = (ki * bk) <= (qi * bq + bq - 1)
+
+    @pl.when(run)
+    def _():
+        p = _recompute_p(q_ref, k_ref, bias_ref, lse_ref, qi, ki, bq, bk,
+                         causal)
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], bh, qi * bq, ki * bk, p.shape,
+                                 dropout_rate)
+            dp = dp * keep / (1.0 - dropout_rate)
+        ds = p * (dp - delta_ref[0, :, 0][:, None])           # (bq, bk)
+        k = k_ref[0]
+        dq_acc[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, bq, bk,
+                    causal, dropout_rate, heads):
+    bh, ki, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = (ki * bk) <= (qi * bq + bq - 1)
+
+    @pl.when(run)
+    def _():
+        p = _recompute_p(q_ref, k_ref, bias_ref, lse_ref, qi, ki, bq, bk,
+                         causal)                              # (bq, bk)
+        do = do_ref[0]                                        # (bq, d)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], bh, qi * bq, ki * bk, p.shape,
+                                 dropout_rate) / (1.0 - dropout_rate)
+            pd = p * keep
+        else:
+            pd = p
+        # dv += pd^T @ do
+        dv_acc[:] += jax.lax.dot_general(pd.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dp = dp * keep
+        ds = p * (dp - delta_ref[0, :, 0][:, None])           # (bq, bk)
+        q = q_ref[0]
+        dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, bias, causal, dropout_rate, seed, heads, out, lse,
+               do, bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K):
+    # delta_i = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                   # (BH, Sq, 1)
+    q, k, v, bias, do, orig_sq, orig_sk = _pad_inputs(q, k, v, bias, do,
+                                                      bq=bq, bk=bk)
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq = min(bq, Sq)
+    bk = min(bk, Sk)
+    if Sq != delta.shape[1]:
+        delta = jnp.pad(delta, ((0, 0), (0, Sq - delta.shape[1]), (0, 0)))
+        lse = jnp.pad(lse, ((0, 0), (0, Sq - lse.shape[1]), (0, 0)))
+    seed_arr = jnp.reshape(jnp.asarray(seed, jnp.int32), (1,))
+
+    common_in = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0),
+                     memory_space=pltpu.VMEM),
+        _bias_spec(bias, heads, bq, bk),
+        pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, causal=causal,
+                          dropout_rate=dropout_rate, heads=heads),
+        grid=(BH, (Sq + bq - 1) // bq, (Sk + bk - 1) // bk),
+        in_specs=common_in,
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=_interpret(),
+    )(seed_arr, q, k, v, bias, do, lse, delta)
+
+    # dkv grid: (BH, nk, nq); index maps swap qi/ki roles
+    dkv_in = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0),
+                     memory_space=pltpu.VMEM),
+        _bias_spec_swapped(bias, heads, bq, bk),
+        pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, causal=causal,
+                          dropout_rate=dropout_rate, heads=heads),
+        grid=(BH, (Sk + bk - 1) // bk, (Sq + bq - 1) // bq),
+        in_specs=dkv_in,
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Sk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=_interpret(),
+    )(seed_arr, q, k, v, bias, do, lse, delta)
+    return dq[:, :orig_sq], dk[:, :orig_sk], dv[:, :orig_sk]
+
+
+def _bias_spec_swapped(bias, heads, bq, bk):
+    b_bcast = bias.shape[0] == 1
+    q_bcast = bias.shape[1] == 1
+
+    def index_map(bh, ki, qi):
+        return (0 if b_bcast else bh // heads, 0 if q_bcast else qi, ki)
+
+    return pl.BlockSpec((1, 1 if q_bcast else bq, bk), index_map,
+                        memory_space=pltpu.VMEM)
+
+
+# ---------------------------------------------------------------------------
+# public entry: custom_vjp over (q, k, v, bias)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_attention(q, k, v, bias, seed=0, causal=False, dropout_rate=0.0,
+                    heads=1):
+    """Fused attention.  q (BH, Sq, D) pre-scaled; k/v (BH, Sk, D);
+    bias (1|B, 1|Sq, Sk) additive f32 (use 0s for none); seed may be a traced
+    int32 (fold your step rng into it).  Returns (BH, Sq, D).
+
+    ``bias`` is NOT differentiated on this path (cotangent is zero): it
+    models masks — data, not parameters — exactly like the reference's CUDA
+    kernels, whose masks have no gradient.  Use ``impl='default'`` /
+    ``attention_core`` for a *learned* additive bias.
+    """
+    out, _ = _flash_fwd(q, k, v, bias, causal, dropout_rate, seed, heads)
+    return out
+
+
+def _vjp_fwd(q, k, v, bias, seed, causal, dropout_rate, heads):
+    out, lse = _flash_fwd(q, k, v, bias, causal, dropout_rate, seed, heads)
+    return out, (q, k, v, bias, seed, out, lse)
+
+
+def _vjp_bwd(causal, dropout_rate, heads, res, do):
+    q, k, v, bias, seed, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, bias, causal, dropout_rate, seed, heads,
+                            out, lse, do)
+    return dq, dk, dv, None, None
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -1,0 +1,36 @@
+"""Fused (masked) softmax+dropout — mirror of
+``apex/contrib/multihead_attn/mask_softmax_dropout_func.py:81``
+(``fast_mask_softmax_dropout_func``).
+
+The reference exposes the middle of the attention pipeline as its own
+autograd function over materialized (B*H, Sq, Sk) scores.  Under XLA the
+chain softmax→mask→dropout fuses into one kernel on its own, so this is a
+jnp expression kept for API parity; the flash path never materializes the
+scores at all (the real win — see ``flash.py``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fast_mask_softmax_dropout_func(is_training, heads, inputs, pad_mask,
+                                   mask_additive, dropout_prob,
+                                   dropout_rng=None):
+    """inputs (B*H, Sq, Sk) attention scores; pad_mask (B, Sk) bool
+    (nonzero = pad) or additive float; returns dropped softmax probs."""
+    BH, Sq, Sk = inputs.shape
+    s = inputs.astype(jnp.float32)
+    if pad_mask is not None:
+        B = pad_mask.shape[0]
+        if mask_additive:
+            m = pad_mask.astype(jnp.float32).reshape(B, 1, 1, Sk)
+        else:
+            m = jnp.where(pad_mask.astype(bool), -jnp.inf, 0.0
+                          ).astype(jnp.float32).reshape(B, 1, 1, Sk)
+        s = (s.reshape(B, BH // B, Sq, Sk) + m).reshape(BH, Sq, Sk)
+    p = jax.nn.softmax(s, axis=-1)
+    if is_training and dropout_prob > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_prob, p.shape)
+        p = p * keep.astype(p.dtype) / (1.0 - dropout_prob)
+    return p.astype(inputs.dtype)

@@ -31,8 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from ...utils.pallas import interpret_mode as _interpret
 
 
 # --------------------------------------------------------------------------

@@ -27,8 +27,7 @@ from .flattener import LANE, DEFAULT_CHUNK
 _BR = DEFAULT_CHUNK // LANE  # block rows per grid step
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from ..utils.pallas import interpret_mode as _interpret
 
 
 def _block_rows(total: int) -> int:

@@ -1,0 +1,9 @@
+"""Shared Pallas helpers."""
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels run in interpret mode off-TPU (CPU tests)."""
+    return jax.default_backend() != "tpu"
