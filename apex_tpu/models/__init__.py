@@ -10,8 +10,15 @@ runnable end-to-end without external model code.
 from .transformer import (TransformerConfig, transformer_init,
                           transformer_apply, transformer_loss,
                           transformer_pspecs, bert_large_config)
+from .resnet import (ResNetConfig, resnet18_config, resnet50_config,
+                     resnet_init, resnet_apply)
+from .dcgan import (DCGANConfig, dcgan_init, generator_apply,
+                    discriminator_apply)
 
 __all__ = [
     "TransformerConfig", "transformer_init", "transformer_apply",
     "transformer_loss", "transformer_pspecs", "bert_large_config",
+    "ResNetConfig", "resnet18_config", "resnet50_config", "resnet_init",
+    "resnet_apply",
+    "DCGANConfig", "dcgan_init", "generator_apply", "discriminator_apply",
 ]

@@ -1,0 +1,115 @@
+"""DCGAN generator/discriminator — the two-optimizer, multi-loss-scaler amp
+workload (reference: ``examples/dcgan/main_amp.py``, which exercises
+``amp.initialize(num_losses=3)`` and per-loss ``scale_loss(..., loss_id=i)``;
+BASELINE config 5).
+
+NHWC, functional init/apply.  BatchNorm is plain per-device (the
+reference's DCGAN uses vanilla nn.BatchNorm2d) with running stats carried in
+an explicit state pytree, so inference is deterministic and batch-
+composition-independent in eval mode.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sync_batchnorm import sync_batch_norm
+
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+@dataclasses.dataclass(frozen=True)
+class DCGANConfig:
+    latent_dim: int = 100
+    feat_g: int = 64
+    feat_d: int = 64
+    channels: int = 3
+    dtype: Any = jnp.float32
+
+
+def _winit(key, shape):
+    # DCGAN init: N(0, 0.02) (examples/dcgan weights_init)
+    return 0.02 * jax.random.normal(key, shape, jnp.float32)
+
+
+def _bn_pair(c):
+    return ({"scale": jnp.ones((c,)), "bn_bias": jnp.zeros((c,))},
+            {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))})
+
+
+def dcgan_init(key, cfg: DCGANConfig):
+    """Returns (params, bn_state)."""
+    kg, kd = jax.random.split(key)
+    gks = jax.random.split(kg, 5)
+    fg, fd, C, Z = cfg.feat_g, cfg.feat_d, cfg.channels, cfg.latent_dim
+    gen = {"deconv0": _winit(gks[0], (4, 4, Z, fg * 8)),
+           "deconv1": _winit(gks[1], (4, 4, fg * 8, fg * 4)),
+           "deconv2": _winit(gks[2], (4, 4, fg * 4, fg * 2)),
+           "deconv3": _winit(gks[3], (4, 4, fg * 2, fg)),
+           "deconv4": _winit(gks[4], (4, 4, fg, C))}
+    gstate = {}
+    for i, c in enumerate([fg * 8, fg * 4, fg * 2, fg]):
+        gen[f"bn{i}"], gstate[f"bn{i}"] = _bn_pair(c)
+    dks = jax.random.split(kd, 5)
+    disc = {"conv0": _winit(dks[0], (4, 4, C, fd)),
+            "conv1": _winit(dks[1], (4, 4, fd, fd * 2)),
+            "conv2": _winit(dks[2], (4, 4, fd * 2, fd * 4)),
+            "conv3": _winit(dks[3], (4, 4, fd * 4, fd * 8)),
+            "conv4": _winit(dks[4], (4, 4, fd * 8, 1))}
+    dstate = {}
+    for i, c in enumerate([fd * 2, fd * 4, fd * 8]):
+        disc[f"bn{i + 1}"], dstate[f"bn{i + 1}"] = _bn_pair(c)
+    return {"gen": gen, "disc": disc}, {"gen": gstate, "disc": dstate}
+
+
+def _bn(x, p, s, train):
+    out, m, v = sync_batch_norm(x, p["scale"], p["bn_bias"], s["mean"],
+                                s["var"], axis_name=(), training=train,
+                                channel_last=True)
+    return out, ({"mean": m, "var": v} if train else s)
+
+
+def generator_apply(params, bn_state, z, cfg: DCGANConfig, *, train=True):
+    """z (N, latent) -> (images (N, 64, 64, C) in [-1, 1], new_bn_state)."""
+    g, gs = params["gen"], bn_state["gen"]
+    ns = dict(gs)
+    dt = cfg.dtype
+    x = z.reshape(z.shape[0], 1, 1, cfg.latent_dim).astype(dt)
+    x = jax.lax.conv_transpose(x, g["deconv0"].astype(dt), (1, 1), "VALID",
+                               dimension_numbers=DN)       # 4x4
+    x, ns["bn0"] = _bn(x, g["bn0"], gs["bn0"], train)
+    x = jax.nn.relu(x)
+    for i, name in enumerate(["deconv1", "deconv2", "deconv3"]):
+        x = jax.lax.conv_transpose(x, g[name].astype(dt), (2, 2), "SAME",
+                                   dimension_numbers=DN)   # 8,16,32
+        x, ns[f"bn{i + 1}"] = _bn(x, g[f"bn{i + 1}"], gs[f"bn{i + 1}"], train)
+        x = jax.nn.relu(x)
+    x = jax.lax.conv_transpose(x, g["deconv4"].astype(dt), (2, 2), "SAME",
+                               dimension_numbers=DN)       # 64x64
+    return jnp.tanh(x), {**bn_state, "gen": ns}
+
+
+def discriminator_apply(params, bn_state, img, cfg: DCGANConfig, *,
+                        train=True):
+    """img (N, 64, 64, C) -> (logits (N,), new_bn_state).  Logits are
+    pre-sigmoid: use BCE-with-logits — safer than the reference's
+    sigmoid+BCE, same optimum."""
+    d, ds = params["disc"], bn_state["disc"]
+    ns = dict(ds)
+    dt = cfg.dtype
+    x = img.astype(dt)
+    x = jax.lax.conv_general_dilated(x, d["conv0"].astype(dt), (2, 2),
+                                     "SAME", dimension_numbers=DN)
+    x = jax.nn.leaky_relu(x, 0.2)
+    for i, name in enumerate(["conv1", "conv2", "conv3"]):
+        x = jax.lax.conv_general_dilated(x, d[name].astype(dt), (2, 2),
+                                         "SAME", dimension_numbers=DN)
+        x, ns[f"bn{i + 1}"] = _bn(x, d[f"bn{i + 1}"], ds[f"bn{i + 1}"], train)
+        x = jax.nn.leaky_relu(x, 0.2)
+    x = jax.lax.conv_general_dilated(x, d["conv4"].astype(dt), (1, 1),
+                                     "VALID", dimension_numbers=DN)
+    return jnp.mean(x, axis=(1, 2, 3)).astype(jnp.float32), \
+        {**bn_state, "disc": ns}

@@ -1,0 +1,193 @@
+"""ResNet (18/50) — the imagenet example workload (reference:
+``examples/imagenet/main_amp.py`` trains torchvision ResNet-50; BASELINE
+configs 2 & 3).
+
+TPU-first: NHWC layout throughout (the layout the reference's groupbn/NHWC
+kernels exist to reach — native here), functional ``init``/``apply`` with an
+explicit batch-norm state pytree, and every norm usable as SyncBatchNorm by
+passing ``axis_name`` (reduces stats over the mesh via
+``apex_tpu.parallel.sync_batch_norm``).  BN param names contain ``bn`` so
+``amp``'s ``keep_batchnorm_fp32`` pytree cast (utils/pytree.py:is_norm_path)
+recognizes them.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sync_batchnorm import sync_batch_norm
+
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    block: str = "bottleneck"            # "basic" | "bottleneck"
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.float32             # activation dtype (amp casts)
+
+
+def resnet50_config(**kw) -> ResNetConfig:
+    return ResNetConfig(**kw)
+
+
+def resnet18_config(**kw) -> ResNetConfig:
+    kw.setdefault("block", "basic")
+    kw.setdefault("stage_sizes", (2, 2, 2, 2))
+    return ResNetConfig(**kw)
+
+
+def _conv_init(key, kh, kw_, cin, cout):
+    fan_in = kh * kw_ * cin
+    std = (2.0 / fan_in) ** 0.5          # He init, matching torchvision
+    return std * jax.random.normal(key, (kh, kw_, cin, cout), jnp.float32)
+
+
+def _bn_params(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bn_bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def _block_channels(cfg, stage):
+    return cfg.width * (2 ** stage)
+
+
+class _KeyGen:
+    """Unbounded stream of PRNG keys (no fixed split count to outgrow)."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __next__(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+def resnet_init(key, cfg: ResNetConfig):
+    """Returns (params, bn_state) pytrees."""
+    expansion = 4 if cfg.block == "bottleneck" else 1
+    params: dict = {}
+    state: dict = {}
+    keys = _KeyGen(key)
+
+    params["conv_init"] = _conv_init(next(keys), 7, 7, 3, cfg.width)
+    params["bn_init"] = _bn_params(cfg.width)
+    state["bn_init"] = _bn_state(cfg.width)
+
+    cin = cfg.width
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        cmid = _block_channels(cfg, si)
+        cout = cmid * expansion
+        for bi in range(n_blocks):
+            name = f"stage{si}_block{bi}"
+            stride = 2 if (si > 0 and bi == 0) else 1
+            bp: dict = {}
+            bs: dict = {}
+            if cfg.block == "bottleneck":
+                bp["conv1"] = _conv_init(next(keys), 1, 1, cin, cmid)
+                bp["conv2"] = _conv_init(next(keys), 3, 3, cmid, cmid)
+                bp["conv3"] = _conv_init(next(keys), 1, 1, cmid, cout)
+                for i, c in (("1", cmid), ("2", cmid), ("3", cout)):
+                    bp[f"bn{i}"] = _bn_params(c)
+                    bs[f"bn{i}"] = _bn_state(c)
+            else:
+                bp["conv1"] = _conv_init(next(keys), 3, 3, cin, cmid)
+                bp["conv2"] = _conv_init(next(keys), 3, 3, cmid, cout)
+                for i, c in (("1", cmid), ("2", cout)):
+                    bp[f"bn{i}"] = _bn_params(c)
+                    bs[f"bn{i}"] = _bn_state(c)
+            if stride != 1 or cin != cout:
+                bp["conv_proj"] = _conv_init(next(keys), 1, 1, cin, cout)
+                bp["bn_proj"] = _bn_params(cout)
+                bs["bn_proj"] = _bn_state(cout)
+            params[name] = bp
+            state[name] = bs
+            cin = cout
+
+    params["fc_w"] = (jax.random.normal(next(keys), (cin, cfg.num_classes),
+                                        jnp.float32)
+                      * (1.0 / cin) ** 0.5)
+    params["fc_b"] = jnp.zeros((cfg.num_classes,), jnp.float32)
+    return params, state
+
+
+def _bn(x, p, s, *, train, axis_name, momentum=0.1, fuse_relu=False, z=None):
+    out, new_m, new_v = sync_batch_norm(
+        x, p["scale"], p["bn_bias"], s["mean"], s["var"],
+        axis_name=axis_name, training=train, momentum=momentum,
+        channel_last=True, fuse_relu=fuse_relu, z=z)
+    new_s = {"mean": new_m, "var": new_v} if train else s
+    return out, new_s
+
+
+def _conv(x, w, stride=1, dilation=1):
+    pad = "SAME"
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), pad,
+        rhs_dilation=(dilation, dilation), dimension_numbers=DN)
+
+
+def resnet_apply(params, bn_state, x, cfg: ResNetConfig, *, train=True,
+                 axis_name=None):
+    """x (N, H, W, 3) -> (logits (N, classes), new_bn_state).
+
+    ``axis_name``: mesh axis (or tuple) for SyncBatchNorm stats; ``None``
+    syncs over any bound data/group axes (single-device = plain BN).
+    """
+    x = x.astype(cfg.dtype)
+    new_state: dict = {}
+    x = _conv(x, params["conv_init"], stride=2)
+    x, new_state["bn_init"] = _bn(x, params["bn_init"], bn_state["bn_init"],
+                                  train=train, axis_name=axis_name,
+                                  fuse_relu=True)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        for bi in range(n_blocks):
+            name = f"stage{si}_block{bi}"
+            bp, bs = params[name], bn_state[name]
+            ns: dict = {}
+            stride = 2 if (si > 0 and bi == 0) else 1
+            residual = x
+            if cfg.block == "bottleneck":
+                y = _conv(x, bp["conv1"])
+                y, ns["bn1"] = _bn(y, bp["bn1"], bs["bn1"], train=train,
+                                   axis_name=axis_name, fuse_relu=True)
+                y = _conv(y, bp["conv2"], stride=stride)
+                y, ns["bn2"] = _bn(y, bp["bn2"], bs["bn2"], train=train,
+                                   axis_name=axis_name, fuse_relu=True)
+                y = _conv(y, bp["conv3"])
+                last_bn = "bn3"
+            else:
+                y = _conv(x, bp["conv1"], stride=stride)
+                y, ns["bn1"] = _bn(y, bp["bn1"], bs["bn1"], train=train,
+                                   axis_name=axis_name, fuse_relu=True)
+                y = _conv(y, bp["conv2"])
+                last_bn = "bn2"
+            if "conv_proj" in bp:
+                residual = _conv(x, bp["conv_proj"], stride=stride)
+                residual, ns["bn_proj"] = _bn(
+                    residual, bp["bn_proj"], bs["bn_proj"], train=train,
+                    axis_name=axis_name)
+            # bn + residual-add + relu in one fused op (the groupbn
+            # batch_norm_add_relu fusion, here fused by XLA)
+            y, ns[last_bn] = _bn(y, bp[last_bn], bs[last_bn], train=train,
+                                 axis_name=axis_name, fuse_relu=True,
+                                 z=residual)
+            new_state[name] = ns
+            x = y
+
+    x = jnp.mean(x, axis=(1, 2))
+    logits = x.astype(jnp.float32) @ params["fc_w"] + params["fc_b"]
+    return logits, new_state

@@ -22,7 +22,10 @@ import jax.numpy as jnp
 # and common hand-rolled names.  The reference's analog is the isinstance check
 # on _BatchNorm modules in convert_network (fp16util.py:60-88).
 _NORM_PAT = re.compile(
-    r"(batch[_]?norm|batch_stats|\bbn\b|group[_]?norm|layer[_]?norm|\bnorm\b)",
+    r"(batch[_]?norm|batch_stats|group[_]?norm|layer[_]?norm"
+    # a path *segment* named bn/bn<digits>/bn_* or norm/norm_* (\b fails on
+    # bn1/bn_bias: digits and _ are word characters)
+    r"|(?:^|[/._])(?:bn\d*|norm)(?:[/._]|$))",
     re.IGNORECASE)
 
 
