@@ -49,9 +49,12 @@ def _dropout_keep(seed, bh, row0, col0, shape, rate):
     cols = col0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
     x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
          + cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
-         + (seed + bh * jnp.int32(7919)).astype(jnp.uint32)
-         * jnp.uint32(0xC2B2AE3D))
+         + seed.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D))
     x = x * jnp.uint32(0xB5297A4D)
+    # mix the head index in its own round: adding a small prime multiple to
+    # the seed (round 1) made (seed, head) pairs collide trivially — two
+    # seeds 7919 apart reused another head's exact mask
+    x = x ^ (bh.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
     x = x ^ (x >> jnp.uint32(8))
     x = x + jnp.uint32(0x68E31DA4)
     x = x ^ (x << jnp.uint32(8))
@@ -163,10 +166,28 @@ def _pad_inputs(q, k, v, bias, do=None, bq=DEFAULT_BLOCK_Q,
     return q, k, v, bias, do, Sq, Sk
 
 
+def _check_bias_layout(q, bias, heads):
+    """Trace-time shape validation.  Lives here (not in the custom_vjp
+    wrapper, whose primal body jax replaces with _vjp_fwd under grad) so it
+    fires on BOTH the inference and training paths."""
+    bh = q.shape[0]
+    if bh % heads:
+        raise ValueError(f"leading dim {bh} is not a multiple of heads="
+                         f"{heads} — pass heads explicitly")
+    if bias.shape[0] not in (1, bh // heads):
+        # bias rows are indexed by bh//heads (batch): a per-batch mask with
+        # the default heads=1 would silently read the wrong batch's rows
+        raise ValueError(
+            f"bias batch dim {bias.shape[0]} matches neither 1 nor "
+            f"batch={bh // heads} (= leading dim {bh} / heads={heads}); "
+            f"pass the heads= the q layout uses")
+
+
 def _flash_fwd(q, k, v, bias, causal, dropout_rate, seed, heads,
                bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K):
     """q (BH, Sq, D), k/v (BH, Sk, D), bias (1|B, 1|Sq, Sk) f32.
     Returns out (BH, Sq, D), lse (BH, Sq, 1) f32."""
+    _check_bias_layout(q, bias, heads)
     q, k, v, bias, _, orig_sq, _ = _pad_inputs(q, k, v, bias, bq=bq, bk=bk)
     BH, Sq, D = q.shape
     Sk = k.shape[1]

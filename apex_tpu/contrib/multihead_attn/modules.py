@@ -162,7 +162,12 @@ class SelfMultiheadAttn:
 
         bias = build_bias(mask, self.mask_additive, batch=B, sq=S, sk=S,
                           use_time_mask=use_time_mask)
-        drop = self.dropout if is_training else 0.0
+        # No rng -> no dropout on EVERY impl (the fast path must not
+        # fall back to a fixed seed: a constant mask every step is
+        # silently-degraded training, and attention_core already
+        # applies none in this situation).
+        drop = (self.dropout
+                if is_training and dropout_rng is not None else 0.0)
 
         if self.impl == "fast":
             H, D = self.num_heads, self.head_dim
@@ -261,7 +266,12 @@ class EncdecMultiheadAttn:
 
         bias = build_bias(mask, False, batch=B, sq=Sq, sk=Sk,
                           use_time_mask=use_time_mask)
-        drop = self.dropout if is_training else 0.0
+        # No rng -> no dropout on EVERY impl (the fast path must not
+        # fall back to a fixed seed: a constant mask every step is
+        # silently-degraded training, and attention_core already
+        # applies none in this situation).
+        drop = (self.dropout
+                if is_training and dropout_rng is not None else 0.0)
 
         if self.impl == "fast":
             causal = use_time_mask and _is_causal_mask(mask)

@@ -134,9 +134,13 @@ def _attention(x, wqkv, bqkv, wo, bo, cfg: TransformerConfig, mask,
     if cfg.causal:
         causal = jnp.tril(jnp.ones((S, S), bool))
         scores = jnp.where(causal[None, None], scores, -jnp.inf)
-    if mask is not None:  # key padding mask: (B, S) True = keep
-        scores = jnp.where(mask[:, None, None, :], scores,
-                           jnp.asarray(-1e9, scores.dtype))
+    if mask is not None:
+        # key padding mask (B, S), nonzero = PAD — the repo-wide polarity
+        # (contrib.multihead_attn / reference apex convention); round 1 used
+        # the inverted True=keep here, silently flipping masks shared with
+        # the contrib modules
+        scores = jnp.where(mask[:, None, None, :] != 0,
+                           jnp.asarray(-1e9, scores.dtype), scores)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     if dropout_rng is not None and cfg.dropout > 0.0:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - cfg.dropout,
@@ -169,7 +173,8 @@ def _layer(x, lp, cfg: TransformerConfig, mask, dropout_rng):
 def transformer_apply(params, tokens, cfg: TransformerConfig, *,
                       mask=None, dropout_rng=None):
     """tokens (B, S) int32 -> logits (B, S, V).  Layers run under lax.scan
-    over the stacked L axis."""
+    over the stacked L axis.  ``mask``: optional key-padding mask (B, S),
+    nonzero = PAD (same polarity as contrib.multihead_attn)."""
     emb = params["embed"]
     dt = cfg.dtype
     x = emb["tok"][tokens].astype(dt) + emb["pos"][: tokens.shape[1]][None].astype(dt)

@@ -112,6 +112,16 @@ class TreeFlattener:
     def per_tensor_norm(self, flat) -> jnp.ndarray:
         return jnp.sqrt(self.per_tensor_sumsq(flat))
 
+    def per_tensor_maxabs(self, flat) -> jnp.ndarray:
+        """Per-leaf max |x| (the ``MaxNormFunctor`` of
+        ``multi_tensor_l2norm_kernel.cu:113``).  Padding rows contribute 0,
+        which cannot exceed a true max-abs.  Returns (num_leaves,) fp32."""
+        rows = jnp.abs(flat.reshape(-1, LANE).astype(jnp.float32))
+        row_max = jnp.max(rows, axis=1)
+        segs = jax.ops.segment_max(
+            row_max, self._row_segments, num_segments=self.num_leaves + 1)
+        return segs[: self.num_leaves]
+
     def broadcast_per_tensor(self, values) -> jnp.ndarray:
         """Expand (num_leaves,) values to a (total,) flat buffer by segment —
         the "per-tensor scalar visible to every element" trick the CUDA side

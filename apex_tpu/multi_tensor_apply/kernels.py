@@ -277,8 +277,10 @@ def fused_sgd_flat(flat_g, flat_p, flat_mom, scalars, *, nesterov=False,
 # update + unscaled LAMB step direction, with global-grad-norm clipping.
 # Stage 2 (per-tensor trust ratio) runs as XLA segment ops in the optimizer —
 # the per-tensor norms come from TreeFlattener.per_tensor_sumsq.
-# scalars: [beta1, beta2, eps, wd, rc1, rc2, clip, inv_scale]
+# scalars: [beta1, beta2, eps, wd, rc1, rc2, clip, inv_scale, beta3]
 #   clip = 1.0 / max(1, global_norm/max_grad_norm)
+#   beta3 = 1-beta1 when grad_averaging else 1.0 (multi_tensor_lamb.cu:41
+#   takes beta3 as an explicit kernel argument; so do we)
 # --------------------------------------------------------------------------
 
 def fused_lamb_stage1_flat(flat_g, flat_p, flat_m, flat_v, scalars, *,
@@ -287,11 +289,12 @@ def fused_lamb_stage1_flat(flat_g, flat_p, flat_m, flat_v, scalars, *,
         b1, b2, eps, wd = s_ref[0, 0], s_ref[0, 1], s_ref[0, 2], s_ref[0, 3]
         rc1, rc2, clip, inv_scale = (s_ref[0, 4], s_ref[0, 5], s_ref[0, 6],
                                      s_ref[0, 7])
+        beta3 = s_ref[0, 8]
         g = g_ref[:].astype(jnp.float32) * inv_scale * clip
         p = p_ref[:]
         if not adam_w_mode:
             g = g + wd * p
-        m = b1 * m_ref[:] + (1.0 - b1) * g
+        m = b1 * m_ref[:] + beta3 * g
         v = b2 * v_ref[:] + (1.0 - b2) * g * g
         u = (m * rc1) / (jnp.sqrt(v * rc2) + eps)
         if adam_w_mode:

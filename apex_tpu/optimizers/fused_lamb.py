@@ -119,7 +119,8 @@ class FusedLAMB(FusedOptimizer):
         clip = self._clip_coeff(gnorm)
         scalars = jnp.stack([jnp.float32(self.beta1), jnp.float32(self.beta2),
                              jnp.float32(self.eps), wd, rc1, rc2, clip,
-                             inv_scale]).reshape(1, 8)
+                             inv_scale, jnp.asarray(beta3, jnp.float32)
+                             ]).reshape(1, 9)
         flat_u, m, v = kernels.fused_lamb_stage1_flat(
             flat_g, flat_p, state.m, state.v, scalars,
             adam_w_mode=self.adam_w_mode)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ._base import FusedOptimizer, tree_zeros_f32, resolve, _f32
+from ..multi_tensor_apply.flattener import LANE
 
 
 class FusedNovoGradState(NamedTuple):
@@ -28,7 +29,7 @@ class FusedNovoGrad(FusedOptimizer):
                  eps=1e-8, weight_decay=0.0, amsgrad=False,
                  reg_inside_moment=False, grad_averaging=True, norm_type=2,
                  init_zero=False, set_grad_none=True, impl="xla"):
-        super().__init__(lr, weight_decay, impl="xla")  # per-layer scalars: XLA path
+        super().__init__(lr, weight_decay, impl)
         if amsgrad:
             raise RuntimeError("FusedNovoGrad does not support AMSGrad.")
         if norm_type not in (2, 0):
@@ -42,6 +43,12 @@ class FusedNovoGrad(FusedOptimizer):
         self.init_zero = init_zero
 
     def init(self, params) -> FusedNovoGradState:
+        if self.impl == "fused":
+            fl = self.flattener_for(params)
+            return FusedNovoGradState(
+                jnp.zeros((), jnp.int32),
+                jnp.zeros((fl.total,), jnp.float32),
+                jnp.zeros((fl.num_leaves,), jnp.float32))
         m = tree_zeros_f32(params)
         v = jax.tree_util.tree_map(
             lambda p: jnp.zeros((), jnp.float32), params)
@@ -56,6 +63,10 @@ class FusedNovoGrad(FusedOptimizer):
         b1, b2, eps = self.beta1, self.beta2, self.eps
         beta3 = 1.0 - b1 if self.grad_averaging else 1.0
         first = state.count == 0
+
+        if self.impl == "fused":
+            return self._step_fused(state, grads, params, count, lr,
+                                    inv_scale, wd, beta3, first)
 
         def upd(g, p, m, v):
             g = _f32(g) * inv_scale
@@ -85,3 +96,38 @@ class FusedNovoGrad(FusedOptimizer):
         new_m = jax.tree_util.tree_map(lambda o: o[1], out, is_leaf=is_t)
         new_v = jax.tree_util.tree_map(lambda o: o[2], out, is_leaf=is_t)
         return new_params, FusedNovoGradState(count, new_m, new_v)
+
+    def _step_fused(self, state, grads, params, count, lr, inv_scale, wd,
+                    beta3, first):
+        """Flat-buffer path: per-layer norms via the flattener's static
+        segment reductions (the ``multi_tensor_novograd.cu`` per-tensor ``v``
+        becomes a (num_leaves,) vector); the elementwise chain runs over one
+        contiguous buffer, fused by XLA into a single pass like LAMB stage 2.
+        """
+        fl = self.flattener_for(params)
+        flat_g = fl.flatten(grads) * inv_scale
+        flat_p = fl.flatten(params)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+
+        if self.norm_type == 2:
+            norm_val = fl.per_tensor_sumsq(flat_g)          # ||g||^2 per leaf
+        else:
+            norm_val = fl.per_tensor_maxabs(flat_g)
+        ema = b2 * state.v + (1.0 - b2) * norm_val
+        v_new = jnp.where(jnp.logical_and(first, not self.init_zero),
+                          norm_val, ema)
+        denom = (jnp.sqrt(v_new) + eps if self.norm_type == 2
+                 else v_new + eps)
+
+        denom_rows = fl.broadcast_rows(denom)               # (rows,)
+        # padding rows broadcast 0 — guard so 0/0 can't seed NaNs into m
+        denom_rows = jnp.where(denom_rows > 0, denom_rows, 1.0)
+        gn = (flat_g.reshape(-1, LANE) / denom_rows[:, None]).reshape(-1)
+        if self.reg_inside_moment:
+            gn = gn + wd * flat_p
+        m_new = b1 * state.m + beta3 * gn
+        u = m_new if self.reg_inside_moment else m_new + wd * flat_p
+        if self.bias_correction:
+            u = u / (1.0 - b1 ** count.astype(jnp.float32))
+        p_new = flat_p - lr * u
+        return fl.unflatten(p_new), FusedNovoGradState(count, m_new, v_new)

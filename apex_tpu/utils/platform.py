@@ -1,0 +1,194 @@
+"""Backend bring-up hardening.
+
+The ambient environment may register a remote-TPU-tunnel jax backend
+("axon", single-client).  Two failure modes matter for driver entry
+points (observed in round 1):
+
+* a second client dialing the tunnel hangs forever (rc=124 timeouts);
+* transient tunnel errors make ``jax.devices()`` raise
+  ``RuntimeError: Unable to initialize backend 'axon'``.
+
+These helpers make entry points deterministic: ``force_cpu`` pins the
+CPU platform (with N virtual devices for SPMD tests) even if jax was
+already imported by a sitecustomize hook, and ``robust_backend``
+tries the ambient (TPU) backend with a retry before falling back to
+CPU — so callers can always produce a result.
+
+This replaces nothing in the reference (CUDA init is in-process there);
+it is the TPU-tunnel analogue of the reference's device-availability
+gating in ``apex/testing/common_utils.py:12-22``.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+import subprocess
+import sys
+import time
+
+import jax
+
+
+def _drop_tunnel_factories() -> None:
+    """Remove remote-tunnel backend factories so backend enumeration can
+    never dial (and hang on) the tunnel."""
+    try:  # pragma: no cover - environment-specific
+        from jax._src import xla_bridge as _xb
+        getattr(_xb, "_backend_factories", {}).pop("axon", None)
+    except Exception:
+        pass
+
+
+def _clear_backends() -> None:
+    """Best-effort reset of jax's backend cache (version-tolerant)."""
+    for attr in ("_clear_backends",):
+        try:  # pragma: no cover - depends on jax version
+            from jax._src import xla_bridge as _xb
+            getattr(_xb, attr)()
+            return
+        except Exception:
+            pass
+    try:  # pragma: no cover
+        jax.clear_caches()
+    except Exception:
+        pass
+
+
+def backends_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge as _xb
+        return bool(_xb.backends_are_initialized())
+    except Exception:
+        return False
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin the CPU platform (with ``n_devices`` virtual devices if given).
+
+    Safe to call whether or not jax has initialized a backend yet; if a
+    different platform is already live (or too few CPU devices exist),
+    the backend cache is cleared and re-created.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if n_devices:
+        pat = r"--xla_force_host_platform_device_count=(\d+)"
+        m = re.search(pat, flags)
+        if m is None:
+            flags = (flags
+                     + f" --xla_force_host_platform_device_count={n_devices}")
+        elif int(m.group(1)) < n_devices:
+            # raise an ambient smaller value, never lower a larger one
+            flags = re.sub(
+                pat, f"--xla_force_host_platform_device_count={n_devices}",
+                flags)
+        os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _drop_tunnel_factories()
+
+    needs_reset = False
+    if backends_initialized():
+        try:
+            needs_reset = (jax.default_backend() != "cpu"
+                           or (n_devices is not None
+                               and jax.device_count() < n_devices))
+        except Exception:
+            needs_reset = True
+    if needs_reset:
+        _clear_backends()
+        try:  # drop executables lowered for the dead backend
+            jax.clear_caches()
+        except Exception:  # pragma: no cover
+            pass
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - config key rename safety
+        pass
+
+
+@contextlib.contextmanager
+def cpu_platform(n_devices: int | None = None):
+    """Scoped ``force_cpu``: on exit, restores the env vars, the tunnel
+    backend factories, and resets the backend cache, so later code in the
+    same process can still bring up the ambient (TPU) backend.  Arrays
+    created inside the scope are dead after exit — use for self-contained
+    work like the driver's multi-chip dryrun."""
+    saved_env = {k: os.environ.get(k) for k in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    try:
+        saved_platforms_cfg = jax.config.jax_platforms
+    except Exception:  # pragma: no cover
+        saved_platforms_cfg = None
+    try:
+        from jax._src import xla_bridge as _xb
+        saved_factories = dict(getattr(_xb, "_backend_factories", {}))
+    except Exception:  # pragma: no cover
+        saved_factories = None
+    force_cpu(n_devices)
+    try:
+        yield
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            jax.config.update("jax_platforms", saved_platforms_cfg)
+        except Exception:  # pragma: no cover
+            pass
+        if saved_factories is not None:
+            try:
+                from jax._src import xla_bridge as _xb
+                _xb._backend_factories.update(saved_factories)
+            except Exception:  # pragma: no cover
+                pass
+        _clear_backends()
+        try:
+            jax.clear_caches()
+        except Exception:  # pragma: no cover
+            pass
+
+
+def probe_ambient(timeout: float = 90.0) -> str | None:
+    """Probe ambient backend bring-up in a THROWAWAY subprocess.
+
+    The tunnel's failure modes include hanging (not just raising) — an
+    in-process ``jax.devices()`` would block forever holding jax's
+    backend lock.  A killed subprocess costs ``timeout`` seconds at
+    worst and leaves this process free to fall back to CPU.  Returns
+    the platform name ("tpu", "cpu", ...) or None on failure/timeout.
+    """
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout)
+    except Exception:
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line[len("PLATFORM="):].strip()
+    return None
+
+
+def robust_backend(retries: int = 2, retry_delay: float = 2.0,
+                   probe_timeout: float = 90.0) -> str:
+    """Bring up *some* usable backend and return its platform name.
+
+    Probes the ambient backend (TPU if the tunnel works) in a
+    subprocess ``retries`` times — hang-proof — and only then
+    initializes it in-process; otherwise neutralizes the tunnel and
+    falls back to CPU.  Never raises on tunnel failure.
+    """
+    for attempt in range(retries):
+        if probe_ambient(probe_timeout) is not None:
+            try:
+                jax.devices()
+                return jax.default_backend()
+            except Exception:
+                pass
+        if attempt + 1 < retries:
+            time.sleep(retry_delay)
+    force_cpu()
+    jax.devices()
+    return jax.default_backend()

@@ -14,10 +14,17 @@ from __future__ import annotations
 
 import functools
 import json
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+
+def _log(msg):
+    """Progress to stderr (driver only parses the stdout JSON line)."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
 
 from apex_tpu.models import bert_large_config, transformer_init
 from apex_tpu.optimizers import FusedLAMB
@@ -47,9 +54,13 @@ def time_apex(impl, make_params, grads):
     state = opt.init(params)
     stepfn = jax.jit(lambda s, g, p: opt.step(s, g, p), donate_argnums=(0, 2))
 
+    _log(f"compiling FusedLAMB impl={impl} ...")
     params, state = stepfn(state, grads, params)  # compile
     _sync(params)
-    return slope_time_ms(stepfn, state, params, grads)
+    _log(f"timing FusedLAMB impl={impl} ...")
+    ms = slope_time_ms(stepfn, state, params, grads)
+    _log(f"FusedLAMB impl={impl}: {ms:.2f} ms/step")
+    return ms
 
 
 def time_optax(make_params, grads):
@@ -68,17 +79,23 @@ def time_optax(make_params, grads):
         s2, p2 = jitted(s, g, p)
         return p2, s2
 
+    _log("compiling optax baseline ...")
     params, state = stepfn(state, grads, params)  # compile
     _sync(params)
-    return slope_time_ms(stepfn, state, params, grads)
+    _log("timing optax baseline ...")
+    ms = slope_time_ms(stepfn, state, params, grads)
+    _log(f"optax baseline: {ms:.2f} ms/step")
+    return ms
 
 
-def main():
+def run_bench():
     on_tpu = jax.default_backend() == "tpu"
+    _log(f"backend={jax.default_backend()} devices={len(jax.devices())}")
     cfg = bert_large_config() if on_tpu else bert_large_config(
         num_layers=2, d_model=256, d_ff=1024, vocab_size=4096, max_len=128,
         num_heads=4)
     make_params = jax.jit(lambda: transformer_init(jax.random.PRNGKey(0), cfg))
+    _log("materializing params ...")
     params = make_params()
     grads = jax.jit(lambda p: jax.tree_util.tree_map(
         lambda x: 0.01 * jnp.ones_like(x), p))(params)
@@ -90,7 +107,7 @@ def main():
     base_ms = time_optax(make_params, grads)
     best_ms = min(xla_ms, fused_ms)
 
-    print(json.dumps({
+    return {
         "metric": "fused_lamb_step_ms_bert_large",
         "value": round(best_ms, 3),
         "unit": "ms",
@@ -100,8 +117,68 @@ def main():
                    "pallas_flat_impl_ms": round(fused_ms, 3),
                    "backend": jax.default_backend(),
                    "n_params": n_params},
-    }))
+    }
+
+
+def _inner_main():
+    """Run the benchmark on the AMBIENT backend and print the JSON line.
+    Raises/hangs are the outer process's problem — that is the point."""
+    print(json.dumps(run_bench()))
+
+
+def main():
+    """ALWAYS print exactly one JSON line, whatever the backend does.
+
+    Round-1 failure modes: the remote-TPU tunnel ("axon") can either raise
+    during bring-up (rc=1, no output) or HANG a second client forever
+    (rc=124).  Both are un-catchable in-process once jax starts dialing,
+    so the TPU attempt runs in a killable subprocess (``--inner``); on
+    failure or timeout the parent neutralizes the tunnel and re-runs on
+    CPU in-process, so a real number is still recorded.
+    """
+    import subprocess
+
+    deadline = time.monotonic() + 430.0   # leave room for the CPU fallback
+    attempt_errs = []
+    for attempt in range(2):
+        budget = deadline - time.monotonic()
+        if budget < 60:
+            break
+        t0 = time.monotonic()
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "--inner"],
+                capture_output=True, text=True, timeout=budget)
+        except subprocess.TimeoutExpired:
+            attempt_errs.append("inner timeout")
+            break                          # a hang won't improve on retry
+        sys.stderr.write(r.stderr or "")
+        for line in (r.stdout or "").splitlines():
+            if line.startswith("{"):
+                print(line)
+                return
+        attempt_errs.append(f"inner rc={r.returncode}: "
+                            + (r.stderr or "")[-200:])
+        if time.monotonic() - t0 > 90:     # slow failure: don't retry
+            break
+
+    from apex_tpu.utils.platform import force_cpu
+    try:
+        force_cpu()
+        payload = run_bench()
+        payload["detail"]["ambient_error"] = "; ".join(attempt_errs)[:300]
+    except Exception as err:               # last resort: still emit the line
+        payload = {
+            "metric": "fused_lamb_step_ms_bert_large",
+            "value": -1.0, "unit": "ms", "vs_baseline": 0.0,
+            "detail": {"error": repr(err)[:300],
+                       "ambient_error": "; ".join(attempt_errs)[:300]},
+        }
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":
-    main()
+    if "--inner" in sys.argv:
+        _inner_main()
+    else:
+        main()

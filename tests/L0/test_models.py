@@ -130,3 +130,32 @@ def test_norm_path_regex_matches_model_bn_names():
     assert cast["bn_init"]["scale"].dtype == jnp.float32
     assert cast["stage0_block0"]["bn1"]["bn_bias"].dtype == jnp.float32
     assert cast["conv_init"].dtype == jnp.bfloat16
+
+
+def test_transformer_mask_polarity_nonzero_is_pad():
+    """Regression for the round-1 inversion: the key-padding mask uses the
+    repo-wide nonzero=PAD polarity (contrib.multihead_attn convention).
+    An all-zeros mask must be a no-op; marking positions as pad must (a)
+    change other positions' outputs and (b) starve the padded queries'
+    attention of real keys only when the REAL keys are marked."""
+    cfg = TransformerConfig(vocab_size=64, max_len=32, num_layers=1,
+                            d_model=32, num_heads=2, d_ff=64)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    toks = (jnp.arange(16)[None] % 64).astype(jnp.int32)
+
+    o_none = transformer_apply(params, toks, cfg)
+    o_zeros = transformer_apply(params, toks, cfg,
+                                mask=jnp.zeros((1, 16), jnp.int32))
+    np.testing.assert_allclose(np.asarray(o_none), np.asarray(o_zeros),
+                               atol=1e-5)
+
+    mask_tail = jnp.zeros((1, 16), jnp.int32).at[0, 8:].set(1)
+    o_tail = transformer_apply(params, toks, cfg, mask=mask_tail)
+    # masking the tail must change the head's outputs (tail keys dropped)
+    assert not np.allclose(np.asarray(o_none[0, :8]),
+                           np.asarray(o_tail[0, :8]), atol=1e-5)
+    # and the head positions must see ONLY head keys: masking the head
+    # instead yields a different result than masking the tail
+    mask_head = jnp.zeros((1, 16), jnp.int32).at[0, :8].set(1)
+    o_head = transformer_apply(params, toks, cfg, mask=mask_head)
+    assert not np.allclose(np.asarray(o_tail), np.asarray(o_head), atol=1e-5)

@@ -141,6 +141,42 @@ def test_lamb_vs_ref(impl):
         assert diff.max() <= TOL, f"{k}: {diff.max()}"
 
 
+@pytest.mark.parametrize("grad_averaging", [True, False])
+@pytest.mark.parametrize("adamw", [True, False])
+def test_lamb_fused_matches_xla_knobs(grad_averaging, adamw):
+    """Fused vs XLA parity across constructor knobs — regression for the
+    round-1 bug where the fused stage-1 kernel hard-coded (1-beta1) and
+    silently ignored grad_averaging=False (multi_tensor_lamb.cu:41 passes
+    beta3 explicitly)."""
+    params = make_params()
+    kw = dict(lr=1e-2, weight_decay=0.01, grad_averaging=grad_averaging,
+              adam_w_mode=adamw)
+    px = run_jax(FusedLAMB(impl="xla", **kw), params)
+    pf = run_jax(FusedLAMB(impl="fused", **kw), params)
+    for k in px:
+        np.testing.assert_allclose(np.asarray(px[k]), np.asarray(pf[k]),
+                                   atol=1e-5, err_msg=k)
+
+
+@pytest.mark.parametrize("norm_type", [2, 0])
+@pytest.mark.parametrize("reg_inside,grad_averaging,init_zero", [
+    (False, True, False), (True, False, True), (False, False, False)])
+def test_novograd_fused_matches_xla_knobs(norm_type, reg_inside,
+                                          grad_averaging, init_zero):
+    """impl='fused' (flat buffer + segment per-layer norms) must match the
+    per-leaf XLA path over every knob combination — regression for round-1's
+    silent impl='xla' fallback (fused_novograd.py:33)."""
+    params = make_params()
+    kw = dict(lr=1e-2, weight_decay=0.01, norm_type=norm_type,
+              reg_inside_moment=reg_inside, grad_averaging=grad_averaging,
+              init_zero=init_zero)
+    px = run_jax(FusedNovoGrad(impl="xla", **kw), params)
+    pf = run_jax(FusedNovoGrad(impl="fused", **kw), params)
+    for k in px:
+        np.testing.assert_allclose(np.asarray(px[k]), np.asarray(pf[k]),
+                                   atol=1e-5, err_msg=k)
+
+
 def test_novograd_runs_and_descends():
     """NovoGrad has no torch oracle; check loss descent + state shapes
     (reference checks numerics vs its own CUDA kernel; our oracle is the

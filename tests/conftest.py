@@ -1,29 +1,21 @@
 """Test harness: force an 8-device CPU platform so multi-chip SPMD paths are
 exercised without TPU hardware (the capability called out in SURVEY §4 —
 ``xla_force_host_platform_device_count`` gives N-device SPMD on CPU, which the
-reference's real-multiprocess test harness could not do)."""
-import os
+reference's real-multiprocess test harness could not do).
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-# Force CPU: the ambient environment may set JAX_PLATFORMS=axon (the real TPU
-# tunnel, single-client) — tests must never contend for the chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
+All platform-forcing logic (env flags, config update, dropping the
+single-client axon TPU-tunnel backend factory so enumeration can never dial
+and hang on it) lives in ``apex_tpu.utils.platform.force_cpu`` — shared with
+the driver entry points so tunnel fixes land in exactly one place.
+Importing apex_tpu imports jax but does NOT initialize a backend, so calling
+``force_cpu`` right after import is still early enough; it also resets an
+already-initialized wrong backend defensively.
+"""
+from apex_tpu.utils.platform import force_cpu
 
-import jax  # noqa: E402  (import after env setup)
+force_cpu(8)
 
-# A sitecustomize hook may have imported jax already (registering a TPU-tunnel
-# "axon" plugin), in which case the env var above came too late — force the
-# platform through the config API, and drop the axon factory so backend
-# enumeration can never dial (and hang on) the tunnel from the test suite.
-jax.config.update("jax_platforms", "cpu")
-try:  # pragma: no cover - environment-specific
-    from jax._src import xla_bridge as _xb
-    getattr(_xb, "_backend_factories", {}).pop("axon", None)
-except Exception:
-    pass
-
+import jax  # noqa: E402
 import pytest  # noqa: E402
 
 
