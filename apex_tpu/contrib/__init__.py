@@ -7,5 +7,6 @@ selected at call time.
 """
 from . import xentropy
 from . import multihead_attn
+from . import optimizers
 
-__all__ = ["xentropy", "multihead_attn"]
+__all__ = ["xentropy", "multihead_attn", "optimizers"]

@@ -1,0 +1,8 @@
+"""ZeRO-style sharded data-parallel optimizers (reference:
+``apex/contrib/optimizers/distributed_fused_adam.py``,
+``distributed_fused_lamb.py``)."""
+from .distributed_fused import (DistributedFusedAdam, DistributedFusedLAMB,
+                                ShardedAdamState, ShardedLAMBState)
+
+__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
+           "ShardedAdamState", "ShardedLAMBState"]

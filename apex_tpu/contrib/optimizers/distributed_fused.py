@@ -1,0 +1,390 @@
+"""ZeRO-style sharded data-parallel fused optimizers.
+
+TPU-native redesign of the reference's most complex distributed capability
+(``apex/contrib/optimizers/distributed_fused_adam.py:297-407,535`` and
+``distributed_fused_lamb.py:417-504``): gradients are reduce-scattered so
+each device owns ``1/N`` of the flat gradient; the fp32 master params and
+both moments live permanently sharded (the ZeRO memory win — optimizer
+state per device is ``1/N`` of the model); the fused update runs on the
+shard; the new params are all-gathered back (optionally in bf16, the TPU
+analog of the reference's ``e5m2_allgather``).
+
+Mechanism mapping (reference → here):
+
+- backward-hook-driven pipelined ``reduce_scatter`` per block/chunk on side
+  streams (``:297-340``) → a single ``jax.lax.psum_scatter`` inside the
+  jitted step.  XLA's latency-hiding scheduler overlaps the collective with
+  whatever compute is adjacent — the manual block/chunk/stream pipeline
+  (``dwu_num_blocks/chunks/rs_pg/ar_pg`` knobs) has no SPMD meaning and is
+  deliberately absent.
+- two-level intra/inter-group topology (``dwu_group_size``; RS within the
+  group, AR across groups ``:333-340``) → ``shard_axis`` (ICI-adjacent mesh
+  axis, carries the scatter/gather) + optional ``replica_axis`` (DCN axis,
+  carries only a ``psum``); optimizer state is replicated across
+  ``replica_axis`` exactly like the reference replicates shards across
+  groups.
+- L2-grad-norm side-allreduce (``compute_L2_grad_norm``, ``:344-354``) →
+  per-shard partial sumsq + ``psum`` over both axes, folded into the same
+  step (no side stream needed).
+- ``revert_method`` 1/2 (undo kernel / double buffer, ``:75-81``) → the
+  update is pure, so overflow-skip is a ``jnp.where`` select of the old
+  (state, params) — strictly cheaper than both revert mechanisms.
+- ``predivide`` (``:309``) → supported: grads are scaled by ``1/world``
+  before the reduction so the sum never overflows fp16/bf16 dynamic range.
+- ``e5m2_allgather`` → ``bf16_allgather`` (bf16 is the TPU-native 8-exp
+  format; e5m2 buys nothing here).
+
+Usage: the step is a *collective* — call it inside ``shard_map`` (or
+``pmap``) with ``shard_axis``/``replica_axis`` bound, passing each device's
+LOCAL unreduced gradients.  For pjit-style automatic-parallelism loops,
+ZeRO-1 is instead expressed by sharding a normal ``FusedAdam`` state with
+``NamedSharding``/``with_sharding_constraint`` — see ``parallel/mesh.py``;
+this module exists for the explicit shard_map world where the reference's
+pipeline semantics (predivide, two-level topology, grad-norm clip, skip on
+overflow) are needed verbatim.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...multi_tensor_apply.flattener import TreeFlattener, LANE
+from ...multi_tensor_apply import kernels
+from ...optimizers._base import resolve
+
+
+class ShardedAdamState(NamedTuple):
+    count: jnp.ndarray        # ()
+    p: jnp.ndarray            # (total/N,) fp32 master shard
+    m: jnp.ndarray            # (total/N,) fp32
+    v: jnp.ndarray            # (total/N,) fp32
+    gnorm: jnp.ndarray        # () last global grad norm (L2_grad_norm analog)
+
+
+class ShardedLAMBState(NamedTuple):
+    count: jnp.ndarray
+    p: jnp.ndarray
+    m: jnp.ndarray
+    v: jnp.ndarray
+    gnorm: jnp.ndarray
+
+
+def _axis_sz(axis) -> int:
+    return jax.lax.psum(1, axis)
+
+
+class _DistributedFusedBase:
+    """Shared sharded-flat-buffer machinery."""
+
+    def __init__(self, lr, weight_decay=0.0, shard_axis="data",
+                 replica_axis: Optional[str] = None, predivide=True,
+                 bf16_allgather=False, check_overflow=True, impl="xla"):
+        if impl not in ("xla", "fused"):
+            raise ValueError(f"impl must be 'xla' or 'fused', got {impl!r}")
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.shard_axis = shard_axis
+        self.replica_axis = replica_axis
+        self.predivide = predivide
+        self.bf16_allgather = bf16_allgather
+        self.check_overflow = check_overflow
+        self.impl = impl
+        self._fl: Optional[TreeFlattener] = None
+        self._fl_key = None
+
+    # -- flat packing --------------------------------------------------------
+
+    def _flattener(self, params, n_shards: int) -> TreeFlattener:
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        key = (treedef, tuple(l.shape for l in leaves), n_shards)
+        if self._fl is None or self._fl_key != key:
+            # chunk = LANE*n_shards ⇒ total % n_shards == 0 and every shard
+            # is a whole number of 128-lanes — the alignment the reference
+            # gets from its block/chunk/shard factorization (init code)
+            self._fl = TreeFlattener(params, chunk=LANE * n_shards)
+            self._fl_key = key
+        return self._fl
+
+    # -- collectives ---------------------------------------------------------
+
+    def _reduce_scatter(self, flat_g):
+        """Local full flat grads -> this device's reduced shard.
+        RS over shard_axis (ICI), then AR over replica_axis (DCN) —
+        the reference's two-level schedule (:329-340) as two collectives."""
+        world = _axis_sz(self.shard_axis)
+        if self.replica_axis is not None:
+            world = world * _axis_sz(self.replica_axis)
+        if self.predivide:
+            flat_g = flat_g * (1.0 / world)
+        g_shard = jax.lax.psum_scatter(flat_g, self.shard_axis,
+                                       scatter_dimension=0, tiled=True)
+        if self.replica_axis is not None:
+            g_shard = jax.lax.psum(g_shard, self.replica_axis)
+        if not self.predivide:
+            g_shard = g_shard / world
+        return g_shard
+
+    def _allgather(self, p_shard):
+        if self.bf16_allgather:
+            p_shard = p_shard.astype(jnp.bfloat16)
+        # all_gather_invariant: identical collective, but its output is
+        # *replicated* under the vma system (every device provably holds the
+        # same full buffer), which is what gathered params are — plain
+        # all_gather would force check_vma=False on every enclosing shard_map
+        try:
+            from jax._src.lax.parallel import all_gather_invariant
+            full = all_gather_invariant(p_shard, self.shard_axis, axis=0,
+                                        tiled=True)
+        except ImportError:  # pragma: no cover - older jax
+            full = jax.lax.all_gather(p_shard, self.shard_axis, axis=0,
+                                      tiled=True)
+        return full.astype(jnp.float32)
+
+    def _global_sumsq(self, x_shard):
+        """Global sum-of-squares from per-device shards (the side grad-norm
+        allreduce, reference :344-354).  Reduces over shard_axis ONLY: in
+        the two-level topology the shard is already identical across
+        replica_axis (the inter-group psum ran), so including it would
+        multiply the norm by the group count."""
+        return jax.lax.psum(jnp.sum(x_shard.astype(jnp.float32) ** 2),
+                            self.shard_axis)
+
+    def _shard_segments(self, fl: TreeFlattener, n_shards: int):
+        """This shard's row->leaf segment ids (dynamic on the shard index:
+        shard_map traces one program for all devices)."""
+        rows = fl.total // LANE
+        rows_per = rows // n_shards
+        idx = jax.lax.axis_index(self.shard_axis)
+        return jax.lax.dynamic_slice(fl._row_segments, (idx * rows_per,),
+                                     (rows_per,))
+
+    def _finite_flag(self, g_shard):
+        """1.0 iff every REDUCED gradient element is finite.  g_shard is
+        post-reduction, so an inf anywhere has already propagated into some
+        shard; min over shard_axis alone sees it (replicas agree)."""
+        ok = jnp.all(jnp.isfinite(g_shard)).astype(jnp.float32)
+        return jax.lax.pmin(ok, self.shard_axis)
+
+    @staticmethod
+    def _select(ok, new, old):
+        """Overflow skip: keep old (state, params) wholesale — the pure-
+        function replacement for the reference's undo-kernel/double-buffer
+        revert (:75-81)."""
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(ok > 0, n, o), new, old)
+
+    # -- state bring-up ------------------------------------------------------
+
+    def _shard_of(self, flat, n_shards):
+        per = flat.shape[0] // n_shards
+        idx = jax.lax.axis_index(self.shard_axis)
+        return jax.lax.dynamic_slice(flat, (idx * per,), (per,))
+
+    def state_pspecs(self):
+        """PartitionSpecs for the state — use as shard_map in/out_specs (or
+        to build NamedShardings): the flat p/m/v buffers are sharded over
+        ``shard_axis`` and replicated over ``replica_axis`` (matching the
+        reference's per-group shard replication); scalars replicated."""
+        from jax.sharding import PartitionSpec as P
+        shard = P(self.shard_axis)
+        return self._state_cls(count=P(), p=shard, m=shard, v=shard,
+                               gnorm=P())
+
+    def init(self, params):
+        """Build the sharded state.  MUST run inside shard_map/pmap with
+        ``shard_axis`` bound (each device slices its own master shard)."""
+        n = _axis_sz(self.shard_axis)
+        fl = self._flattener(params, n)
+        p_shard = self._shard_of(fl.flatten(params), n)
+        # m and v are distinct buffers (donating a shared array twice is an
+        # aliasing error on TPU)
+        return self._state_cls(jnp.zeros((), jnp.int32), p_shard,
+                               jnp.zeros_like(p_shard),
+                               jnp.zeros_like(p_shard),
+                               jnp.zeros((), jnp.float32))
+
+
+class DistributedFusedAdam(_DistributedFusedBase):
+    """Sharded-DP Adam(W).  Matches ``DistributedFusedAdam`` semantics
+    (reference ``distributed_fused_adam.py:535`` step path) with FusedAdam's
+    math (``multi_tensor_adam.cu`` AdamFunctor)."""
+
+    _state_cls = ShardedAdamState
+
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-8, weight_decay=0.0, amsgrad=False, adam_w_mode=True,
+                 max_grad_norm=0.0, **kw):
+        super().__init__(lr, weight_decay, **kw)
+        if amsgrad:
+            raise RuntimeError(
+                "DistributedFusedAdam does not support the AMSGrad variant "
+                "(reference distributed_fused_adam.py:62).")
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.max_grad_norm = max_grad_norm
+
+    def step(self, state: ShardedAdamState, grads, params, *, scale=1.0,
+             lr=None):
+        """One collective step.  ``grads``: this device's local UNREDUCED
+        grads (full model); returns (new_params_full_tree, new_state)."""
+        n = _axis_sz(self.shard_axis)
+        fl = self._flattener(params, n)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+
+        g_shard = self._reduce_scatter(fl.flatten(grads))
+        ok = (self._finite_flag(g_shard) if self.check_overflow
+              else jnp.ones((), jnp.float32))
+
+        # grad-norm side-reduce + clip folded into the update scale, like
+        # __launch_step_kernel's combined_scale (reference :355-371)
+        gnorm = jnp.sqrt(self._global_sumsq(g_shard)) * inv_scale
+        if self.max_grad_norm and self.max_grad_norm > 0:
+            clip = 1.0 / jnp.maximum(1.0, gnorm / self.max_grad_norm)
+        else:
+            clip = jnp.ones((), jnp.float32)
+
+        count = state.count + 1
+        lr_v = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
+                           jnp.float32)
+        b1, b2 = self.beta1, self.beta2
+        if self.bias_correction:
+            t = count.astype(jnp.float32)
+            rc1 = 1.0 / (1.0 - b1 ** t)
+            rc2 = 1.0 / (1.0 - b2 ** t)
+        else:
+            rc1 = rc2 = jnp.ones((), jnp.float32)
+        eff_scale = inv_scale * clip
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+
+        if self.impl == "fused":
+            scalars = jnp.stack([lr_v, jnp.float32(b1), jnp.float32(b2),
+                                 jnp.float32(self.eps), wd, rc1, rc2,
+                                 eff_scale]).reshape(1, 8)
+            p_new, m_new, v_new = kernels.fused_adam_flat(
+                g_shard, state.p, state.m, state.v, scalars,
+                adam_w_mode=self.adam_w_mode)
+        else:
+            g = g_shard * eff_scale
+            p = state.p
+            if not self.adam_w_mode:
+                g = g + wd * p
+            m_new = b1 * state.m + (1.0 - b1) * g
+            v_new = b2 * state.v + (1.0 - b2) * g * g
+            u = (m_new * rc1) / (jnp.sqrt(v_new * rc2) + self.eps)
+            if self.adam_w_mode:
+                u = u + wd * p
+            p_new = p - lr_v * u
+
+        new_state = ShardedAdamState(count, p_new, m_new, v_new, gnorm)
+        new_state = self._select(ok, new_state,
+                                 state._replace(gnorm=gnorm))
+        full = self._allgather(new_state.p)
+        return fl.unflatten(full), new_state
+
+
+class DistributedFusedLAMB(_DistributedFusedBase):
+    """Sharded-DP LAMB.  Matches ``DistributedFusedLAMB``'s pipeline
+    (reference ``distributed_fused_lamb.py:417-504,570``): RS/AR grad
+    reduction, grad-norm allreduce (:450), sharded two-stage LAMB update
+    (``multi_tensor_distopt_lamb_kernel.cu``), param all-gather (:504).
+    The per-tensor trust ratios — whose norms span shards — come from
+    per-shard segment partial sums + a psum, replacing the kernel-side
+    partial-norm machinery."""
+
+    _state_cls = ShardedLAMBState
+
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-6, weight_decay=0.01, amsgrad=False, adam_w_mode=True,
+                 grad_averaging=True, max_grad_norm=1.0, use_nvlamb=False,
+                 **kw):
+        super().__init__(lr, weight_decay, **kw)
+        if amsgrad:
+            raise RuntimeError("DistributedFusedLAMB does not support "
+                               "AMSGrad.")
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def step(self, state: ShardedLAMBState, grads, params, *, scale=1.0,
+             lr=None):
+        n = _axis_sz(self.shard_axis)
+        fl = self._flattener(params, n)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+
+        g_shard = self._reduce_scatter(fl.flatten(grads))
+        ok = (self._finite_flag(g_shard) if self.check_overflow
+              else jnp.ones((), jnp.float32))
+
+        gnorm = jnp.sqrt(self._global_sumsq(g_shard)) * inv_scale
+        if self.max_grad_norm and self.max_grad_norm > 0:
+            clip = 1.0 / jnp.maximum(1.0, gnorm / self.max_grad_norm)
+        else:
+            clip = jnp.ones((), jnp.float32)
+
+        count = state.count + 1
+        lr_v = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
+                           jnp.float32)
+        b1, b2 = self.beta1, self.beta2
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        if self.bias_correction:
+            t = count.astype(jnp.float32)
+            rc1 = 1.0 / (1.0 - b1 ** t)
+            rc2 = 1.0 / (1.0 - b2 ** t)
+        else:
+            rc1 = rc2 = jnp.ones((), jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+
+        # stage 1 on the shard (same math as the single-device kernel)
+        if self.impl == "fused":
+            scalars = jnp.stack([jnp.float32(b1), jnp.float32(b2),
+                                 jnp.float32(self.eps), wd, rc1, rc2, clip,
+                                 inv_scale, jnp.asarray(beta3, jnp.float32)
+                                 ]).reshape(1, 9)
+            u, m_new, v_new = kernels.fused_lamb_stage1_flat(
+                g_shard, state.p, state.m, state.v, scalars,
+                adam_w_mode=self.adam_w_mode)
+        else:
+            g = g_shard * inv_scale * clip
+            p = state.p
+            if not self.adam_w_mode:
+                g = g + wd * p
+            m_new = b1 * state.m + beta3 * g
+            v_new = b2 * state.v + (1.0 - b2) * g * g
+            u = (m_new * rc1) / (jnp.sqrt(v_new * rc2) + self.eps)
+            if self.adam_w_mode:
+                u = u + wd * state.p
+
+        # stage 2: per-tensor trust ratios across shards
+        segs = self._shard_segments(fl, n)
+        num = fl.num_leaves + 1
+
+        def seg_sumsq(x):
+            # shard_axis only: state shards are replica_axis-invariant
+            rows = x.reshape(-1, LANE).astype(jnp.float32)
+            part = jax.ops.segment_sum(jnp.sum(rows * rows, axis=1), segs,
+                                       num_segments=num)
+            return jax.lax.psum(part, self.shard_axis)[: fl.num_leaves]
+
+        w_norm = jnp.sqrt(seg_sumsq(state.p))
+        u_norm = jnp.sqrt(seg_sumsq(u))
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        if not self.use_nvlamb and self.weight_decay == 0.0:
+            ratio = jnp.ones_like(ratio)
+        ratio_pad = jnp.concatenate([ratio, jnp.zeros((1,), jnp.float32)])
+        ratio_rows = ratio_pad[segs]                       # (shard rows,)
+        u_rows = u.reshape(-1, LANE)
+        p_new = (state.p.reshape(u_rows.shape)
+                 - lr_v * ratio_rows[:, None] * u_rows).reshape(state.p.shape)
+
+        new_state = ShardedLAMBState(count, p_new, m_new, v_new, gnorm)
+        new_state = self._select(ok, new_state, state._replace(gnorm=gnorm))
+        full = self._allgather(new_state.p)
+        return fl.unflatten(full), new_state

@@ -68,7 +68,10 @@ class TreeFlattener:
             r0 = off // LANE
             r1 = (off + _round_up(size, LANE)) // LANE
             row_seg[r0:r1] = i
-        self._row_segments = jnp.asarray(row_seg)
+        # kept as NUMPY: a jnp array materialized here would be a tracer when
+        # the flattener is (re)built inside a jit/shard_map trace and leak
+        # into later traces via the cache; numpy constants are trace-safe
+        self._row_segments = row_seg
 
     # -- packing -------------------------------------------------------------
 

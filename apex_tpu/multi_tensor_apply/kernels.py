@@ -27,7 +27,8 @@ from .flattener import LANE, DEFAULT_CHUNK
 _BR = DEFAULT_CHUNK // LANE  # block rows per grid step
 
 
-from ..utils.pallas import interpret_mode as _interpret
+from ..utils.pallas import interpret_mode as _interpret, out_vma as _out_vma, \
+    sds as _sds, align_vma as _align_vma
 
 
 def _block_rows(total: int) -> int:
@@ -68,12 +69,13 @@ def _grid_call(kernel, flats, out_dtypes, *, scalars=None, aliases=None,
             (block_rows, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM))
         ins.append(v)
 
-    out_shape = [jax.ShapeDtypeStruct((rows, LANE), d) for d in out_dtypes]
+    ins, vma = _align_vma(ins)
+    out_shape = [_sds((rows, LANE), d, vma) for d in out_dtypes]
     out_specs = [pl.BlockSpec((block_rows, LANE), lambda i: (i, 0),
                               memory_space=pltpu.VMEM)
                  for _ in out_dtypes]
     if with_flag:
-        out_shape.append(jax.ShapeDtypeStruct((1, 1), jnp.int32))
+        out_shape.append(_sds((1, 1), jnp.int32, vma))
         out_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0),
                                       memory_space=pltpu.SMEM))
 
@@ -189,7 +191,7 @@ def multi_tensor_l2norm(flat_in):
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0),
                                memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        out_shape=_sds((1, 1), jnp.float32, _out_vma(flat_in)),
         interpret=_interpret(),
     )(flat_in.reshape(rows, LANE))
     return jnp.sqrt(sumsq[0, 0])

@@ -7,3 +7,54 @@ import jax
 def interpret_mode() -> bool:
     """Pallas kernels run in interpret mode off-TPU (CPU tests)."""
     return jax.default_backend() != "tpu"
+
+
+def _vma_of(a):
+    try:
+        return jax.typeof(a).vma
+    except AttributeError:  # pragma: no cover - jax without vma typing
+        return None
+
+
+def _to_varying(a, axes):
+    try:
+        return jax.lax.pcast(a, axes, to="varying")
+    except AttributeError:  # pragma: no cover - jax with only legacy pvary
+        return jax.lax.pvary(a, axes)
+
+
+def out_vma(*arrays):
+    """Varying-mesh-axes set for pallas_call out_shapes: the union of the
+    inputs' vma (under shard_map(check_vma=True) outputs inherit what the
+    inputs vary over; elsewhere this is just frozenset()).  Returns None on
+    jax versions without vma-typed avals so ShapeDtypeStruct gets its
+    default."""
+    union = frozenset()
+    for a in arrays:
+        v = _vma_of(a)
+        if v is None:
+            return None
+        union = union | v
+    return union
+
+
+def align_vma(arrays):
+    """Lift every array to the union vma (a no-op outside shard_map).
+    Pallas interpret-mode evaluates the kernel body with the operands'
+    types, and mixed vma (a varying grad next to a replicated scalar) is a
+    type error there.  Returns (arrays, union_vma)."""
+    union = out_vma(*arrays)
+    if not union:
+        return list(arrays), union
+    out = []
+    for a in arrays:
+        missing = tuple(union - _vma_of(a))
+        out.append(_to_varying(a, missing) if missing else a)
+    return out, union
+
+
+def sds(shape, dtype, vma):
+    """ShapeDtypeStruct with vma when supported (vma=None -> plain)."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)

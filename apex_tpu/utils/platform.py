@@ -10,9 +10,11 @@ points (observed in round 1):
 
 These helpers make entry points deterministic: ``force_cpu`` pins the
 CPU platform (with N virtual devices for SPMD tests) even if jax was
-already imported by a sitecustomize hook, and ``robust_backend``
-tries the ambient (TPU) backend with a retry before falling back to
-CPU — so callers can always produce a result.
+already imported by a sitecustomize hook; ``cpu_platform`` scopes that
+and restores the ambient backend on exit.  Hang-PROOF handling of a
+wedged tunnel cannot be done in-process (the dial blocks in C++ holding
+jax's backend lock) — processes that must survive it run the ambient
+attempt in a killable subprocess instead (see bench.py main()).
 
 This replaces nothing in the reference (CUDA init is in-process there);
 it is the TPU-tunnel analogue of the reference's device-availability
@@ -23,9 +25,6 @@ from __future__ import annotations
 import contextlib
 import os
 import re
-import subprocess
-import sys
-import time
 
 import jax
 
@@ -83,6 +82,16 @@ def force_cpu(n_devices: int | None = None) -> None:
                 pat, f"--xla_force_host_platform_device_count={n_devices}",
                 flags)
         os.environ["XLA_FLAGS"] = flags.strip()
+        # XLA parses XLA_FLAGS once per process — if a backend already came
+        # up, the raised flag is ignored.  jax_num_cpu_devices is read at
+        # client-creation time, so it works for post-init resets too (the
+        # env flag still matters for child processes).
+        try:
+            cur = jax.config.jax_num_cpu_devices
+            if cur is None or cur < n_devices:
+                jax.config.update("jax_num_cpu_devices", n_devices)
+        except Exception:  # pragma: no cover - option absent in older jax
+            pass
     os.environ["JAX_PLATFORMS"] = "cpu"
     _drop_tunnel_factories()
 
@@ -119,6 +128,10 @@ def cpu_platform(n_devices: int | None = None):
     except Exception:  # pragma: no cover
         saved_platforms_cfg = None
     try:
+        saved_num_cpu = jax.config.jax_num_cpu_devices
+    except Exception:  # pragma: no cover
+        saved_num_cpu = None
+    try:
         from jax._src import xla_bridge as _xb
         saved_factories = dict(getattr(_xb, "_backend_factories", {}))
     except Exception:  # pragma: no cover
@@ -136,6 +149,11 @@ def cpu_platform(n_devices: int | None = None):
             jax.config.update("jax_platforms", saved_platforms_cfg)
         except Exception:  # pragma: no cover
             pass
+        if saved_num_cpu is not None:
+            try:
+                jax.config.update("jax_num_cpu_devices", saved_num_cpu)
+            except Exception:  # pragma: no cover
+                pass
         if saved_factories is not None:
             try:
                 from jax._src import xla_bridge as _xb
@@ -147,48 +165,3 @@ def cpu_platform(n_devices: int | None = None):
             jax.clear_caches()
         except Exception:  # pragma: no cover
             pass
-
-
-def probe_ambient(timeout: float = 90.0) -> str | None:
-    """Probe ambient backend bring-up in a THROWAWAY subprocess.
-
-    The tunnel's failure modes include hanging (not just raising) — an
-    in-process ``jax.devices()`` would block forever holding jax's
-    backend lock.  A killed subprocess costs ``timeout`` seconds at
-    worst and leaves this process free to fall back to CPU.  Returns
-    the platform name ("tpu", "cpu", ...) or None on failure/timeout.
-    """
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print('PLATFORM=' + jax.default_backend())"],
-            capture_output=True, text=True, timeout=timeout)
-    except Exception:
-        return None
-    for line in r.stdout.splitlines():
-        if line.startswith("PLATFORM="):
-            return line[len("PLATFORM="):].strip()
-    return None
-
-
-def robust_backend(retries: int = 2, retry_delay: float = 2.0,
-                   probe_timeout: float = 90.0) -> str:
-    """Bring up *some* usable backend and return its platform name.
-
-    Probes the ambient backend (TPU if the tunnel works) in a
-    subprocess ``retries`` times — hang-proof — and only then
-    initializes it in-process; otherwise neutralizes the tunnel and
-    falls back to CPU.  Never raises on tunnel failure.
-    """
-    for attempt in range(retries):
-        if probe_ambient(probe_timeout) is not None:
-            try:
-                jax.devices()
-                return jax.default_backend()
-            except Exception:
-                pass
-        if attempt + 1 < retries:
-            time.sleep(retry_delay)
-    force_cpu()
-    jax.devices()
-    return jax.default_backend()
