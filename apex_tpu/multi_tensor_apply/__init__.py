@@ -18,9 +18,7 @@ from .kernels import (
     multi_tensor_axpby,
     multi_tensor_l2norm,
     fused_adam_flat,
-    fused_sgd_flat,
     fused_lamb_stage1_flat,
-    fused_adagrad_flat,
 )
 
 
@@ -52,6 +50,6 @@ multi_tensor_applier = MultiTensorApply()
 __all__ = [
     "TreeFlattener", "LANE", "DEFAULT_CHUNK", "kernels",
     "multi_tensor_scale", "multi_tensor_axpby", "multi_tensor_l2norm",
-    "fused_adam_flat", "fused_sgd_flat", "fused_lamb_stage1_flat",
-    "fused_adagrad_flat", "MultiTensorApply", "multi_tensor_applier",
+    "fused_adam_flat", "fused_lamb_stage1_flat",
+    "MultiTensorApply", "multi_tensor_applier",
 ]

@@ -238,43 +238,6 @@ def fused_adam_flat(flat_g, flat_p, flat_m, flat_v, scalars, *,
 
 
 # --------------------------------------------------------------------------
-# multi_tensor_sgd (multi_tensor_sgd_kernel.cu): momentum SGD with the
-# reference's knobs (nesterov, dampening, wd placement, first_run).
-# scalars: [lr, momentum, dampening, wd, inv_scale]
-# --------------------------------------------------------------------------
-
-def fused_sgd_flat(flat_g, flat_p, flat_mom, scalars, *, nesterov=False,
-                   first_run=False, wd_after_momentum=False, model_dtype=None):
-    out_dtypes = [jnp.float32, jnp.float32]
-    if model_dtype is not None:
-        out_dtypes.append(jnp.dtype(model_dtype))
-
-    def kernel(s_ref, g_ref, p_ref, mom_ref, po_ref, mo_ref, *maybe_model):
-        lr, mu, damp, wd, inv_scale = (s_ref[0, 0], s_ref[0, 1], s_ref[0, 2],
-                                       s_ref[0, 3], s_ref[0, 4])
-        g = g_ref[:].astype(jnp.float32) * inv_scale
-        p = p_ref[:]
-        if not wd_after_momentum:
-            g = g + wd * p
-        if first_run:
-            mom = g
-        else:
-            mom = mu * mom_ref[:] + (1.0 - damp) * g
-        upd = g + mu * mom if nesterov else mom
-        if wd_after_momentum:
-            upd = upd + wd * p
-        p_new = p - lr * upd
-        po_ref[:] = p_new
-        mo_ref[:] = mom
-        if maybe_model:
-            maybe_model[0][:] = p_new.astype(maybe_model[0].dtype)
-
-    outs, _ = _grid_call(kernel, [flat_g, flat_p, flat_mom], out_dtypes,
-                         scalars=scalars, aliases={1: 0, 2: 1})
-    return outs
-
-
-# --------------------------------------------------------------------------
 # multi_tensor_lamb stage 1 (multi_tensor_lamb.cu LAMBStage1Functor): m/v
 # update + unscaled LAMB step direction, with global-grad-norm clipping.
 # Stage 2 (per-tensor trust ratio) runs as XLA segment ops in the optimizer —
@@ -311,29 +274,8 @@ def fused_lamb_stage1_flat(flat_g, flat_p, flat_m, flat_v, scalars, *,
     return outs  # [update, m, v]
 
 
-# --------------------------------------------------------------------------
-# multi_tensor_adagrad (multi_tensor_adagrad.cu): h += g^2; p -= lr*g/(sqrt+eps)
-# scalars: [lr, eps, wd, inv_scale]
-# --------------------------------------------------------------------------
-
-def fused_adagrad_flat(flat_g, flat_p, flat_h, scalars, *, model_dtype=None):
-    out_dtypes = [jnp.float32, jnp.float32]
-    if model_dtype is not None:
-        out_dtypes.append(jnp.dtype(model_dtype))
-
-    def kernel(s_ref, g_ref, p_ref, h_ref, po_ref, ho_ref, *maybe_model):
-        lr, eps, wd, inv_scale = (s_ref[0, 0], s_ref[0, 1], s_ref[0, 2],
-                                  s_ref[0, 3])
-        g = g_ref[:].astype(jnp.float32) * inv_scale
-        p = p_ref[:]
-        g = g + wd * p
-        h = h_ref[:] + g * g
-        p_new = p - lr * g / (jnp.sqrt(h) + eps)
-        po_ref[:] = p_new
-        ho_ref[:] = h
-        if maybe_model:
-            maybe_model[0][:] = p_new.astype(maybe_model[0].dtype)
-
-    outs, _ = _grid_call(kernel, [flat_g, flat_p, flat_h], out_dtypes,
-                         scalars=scalars, aliases={1: 0, 2: 1})
-    return outs
+# NOTE: the SGD/Adagrad Pallas kernels were retired in round 3 — the fused
+# optimizers now do their elementwise math as XLA fusions over the
+# permanently-flat state, which measured faster than any Pallas elementwise
+# variant on TPU (PERF_NOTES.md §2).  The Adam/LAMB-stage1 kernels above
+# remain in use by the sharded ZeRO optimizers (contrib/optimizers).

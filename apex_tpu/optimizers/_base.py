@@ -10,14 +10,26 @@ implementations:
   fused elementwise loop per leaf inside a single executable — the kernel
   -launch-overhead problem the CUDA multi-tensor engine solves does not exist
   inside one XLA program.
-- ``impl="fused"``: the Pallas flat-buffer path (``multi_tensor_apply``) —
-  optimizer state (and optionally master params) live permanently in one
-  contiguous fp32 buffer; one chunked Pallas kernel performs the update.
-  This is the architectural mirror of ``amp_C`` and the perf-measurement
-  vehicle for BASELINE's "FusedLAMB step-time" metric.
+- ``impl="fused"``: the flat-buffer engine (``multi_tensor_apply``) —
+  optimizer state AND master params live permanently in one contiguous fp32
+  buffer per field; the update is expressed as XLA elementwise math over the
+  flat buffers (plus the flattener's static per-tensor reductions), which on
+  TPU measures at full HBM bandwidth.  This is the architectural mirror of
+  ``amp_C``'s multi-tensor engine, and the perf-measurement vehicle for
+  BASELINE's "FusedLAMB step-time" metric.  See PERF_NOTES.md for the
+  measurements that chose XLA-on-flat over Pallas elementwise kernels.
 
-Both produce identical numerics (tested against torch.optim oracles like
-``tests/L0/run_optimizers/test_adam.py:8-60``).
+The fused impl's native API is flat: ``step_flat(state, flat_grads)`` updates
+the state (master included) with zero per-step packing; the tree-level
+``step(state, grads, params)`` compat wrapper flattens grads and unflattens
+the master every call (convenient, but pays ~2 extra buffer copies — use
+``step_flat`` + ``model_params`` in performance-critical loops).  In fused
+mode the flat master weights in the state are authoritative; the ``params``
+argument of ``step`` supplies structure/dtypes only (matching the
+reference's master-weight contract, ``apex/contrib/optimizers/fp16_optimizer.py:4``).
+
+Both impls produce identical numerics (tested against torch.optim oracles
+like ``tests/L0/run_optimizers/test_adam.py:8-60``).
 """
 from __future__ import annotations
 
@@ -76,6 +88,27 @@ class FusedOptimizer:
             self._flattener = TreeFlattener(params)
             self._flattener_key = key
         return self._flattener
+
+    @property
+    def flattener(self) -> TreeFlattener:
+        """The packing plan from the last ``init``/``flattener_for`` call —
+        what ``step_flat`` callers use to pack grads / unpack params."""
+        if self._flattener is None:
+            raise RuntimeError("no flattener yet: call init(params) first")
+        return self._flattener
+
+    def step_flat(self, state, flat_grads, *, scale=1.0, lr=None):
+        """Flat-native update (impl='fused' only): new state whose ``master``
+        field holds the updated flat fp32 params.  Zero per-step packing —
+        the fast path for flat-native training loops."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no fused impl" if self.impl != "fused"
+            else f"{type(self).__name__}.step_flat not implemented")
+
+    def model_params(self, state, dtype=None):
+        """Unpack the fused state's flat master into a param tree (the
+        master->model copy; pass dtype=bfloat16 for the amp model copy)."""
+        return self.flattener.unflatten(state.master, dtype=dtype)
 
     # optax-style aliases so apex_tpu optimizers drop into optax training loops
     def update(self, grads, state, params):

@@ -9,12 +9,12 @@ import jax
 import jax.numpy as jnp
 
 from ._base import FusedOptimizer, tree_zeros_f32, resolve, _f32
-from ..multi_tensor_apply import kernels
 
 
 class FusedAdagradState(NamedTuple):
     count: jnp.ndarray
     h: Any
+    master: Any = None   # fused impl: flat fp32 master params (authoritative)
 
 
 class FusedAdagrad(FusedOptimizer):
@@ -27,24 +27,39 @@ class FusedAdagrad(FusedOptimizer):
         if self.impl == "fused":
             fl = self.flattener_for(params)
             return FusedAdagradState(jnp.zeros((), jnp.int32),
-                                     jnp.zeros((fl.total,), jnp.float32))
+                                     jnp.zeros((fl.total,), jnp.float32),
+                                     fl.flatten(params))
         return FusedAdagradState(jnp.zeros((), jnp.int32),
                                  tree_zeros_f32(params))
 
-    def step(self, state, grads, params, *, scale=1.0, lr=None):
+    def step_flat(self, state, flat_grads, *, scale=1.0, lr=None):
+        """Flat-native Adagrad (``multi_tensor_adagrad.cu`` math as one XLA
+        elementwise fusion over the permanently-flat buffers)."""
         count = state.count + 1
         lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
                          jnp.float32)
         inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
         wd = jnp.asarray(self.weight_decay, jnp.float32)
 
+        g = flat_grads.astype(jnp.float32) * inv_scale
+        p = state.master
+        g = g + wd * p
+        h = state.h + g * g
+        return FusedAdagradState(count, h,
+                                 p - lr * g / (jnp.sqrt(h) + self.eps))
+
+    def step(self, state, grads, params, *, scale=1.0, lr=None):
         if self.impl == "fused":
             fl = self.flattener_for(params)
-            scalars = jnp.stack([lr, jnp.float32(self.eps), wd,
-                                 inv_scale]).reshape(1, 4)
-            flat_p, h = kernels.fused_adagrad_flat(
-                fl.flatten(grads), fl.flatten(params), state.h, scalars)
-            return fl.unflatten(flat_p), FusedAdagradState(count, h)
+            new_state = self.step_flat(state, fl.flatten(grads), scale=scale,
+                                       lr=lr)
+            return fl.unflatten(new_state.master), new_state
+
+        count = state.count + 1
+        lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
+                         jnp.float32)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
 
         eps = self.eps
 

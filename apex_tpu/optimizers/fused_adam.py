@@ -15,13 +15,13 @@ import jax
 import jax.numpy as jnp
 
 from ._base import FusedOptimizer, tree_zeros_f32, resolve, _f32
-from ..multi_tensor_apply import kernels
 
 
 class FusedAdamState(NamedTuple):
     count: jnp.ndarray   # i32 step counter
     m: Any               # pytree (xla) or flat buffer (fused)
     v: Any
+    master: Any = None   # fused impl: flat fp32 master params (authoritative)
 
 
 class FusedAdam(FusedOptimizer):
@@ -47,7 +47,8 @@ class FusedAdam(FusedOptimizer):
             # donate_argnums) is an aliasing error on the TPU backend
             return FusedAdamState(jnp.zeros((), jnp.int32),
                                   jnp.zeros((fl.total,), jnp.float32),
-                                  jnp.zeros((fl.total,), jnp.float32))
+                                  jnp.zeros((fl.total,), jnp.float32),
+                                  fl.flatten(params))
         z = tree_zeros_f32(params)
         return FusedAdamState(jnp.zeros((), jnp.int32), z,
                               tree_zeros_f32(params))
@@ -64,16 +65,19 @@ class FusedAdam(FusedOptimizer):
     def step(self, state, grads, params, *, scale=1.0, lr=None):
         """One fused update.  ``scale`` divides grads (amp loss-scale interop,
         reference step(..., scale) API); returns (new_params, new_state)."""
+        if self.impl == "fused":
+            fl = self.flattener_for(params)
+            new_state = self.step_flat(state, fl.flatten(grads), scale=scale,
+                                       lr=lr)
+            return (fl.unflatten(new_state.master, dtype=self.model_dtype),
+                    new_state)
+
         count = state.count + 1
         lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
                          jnp.float32)
         rc1, rc2 = self._corrections(count)
         inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
         wd = jnp.asarray(self.weight_decay, jnp.float32)
-
-        if self.impl == "fused":
-            return self._step_fused(state, grads, params, count, lr, rc1, rc2,
-                                    inv_scale, wd)
 
         b1, b2, eps, adamw = self.beta1, self.beta2, self.eps, self.adam_w_mode
 
@@ -100,20 +104,24 @@ class FusedAdam(FusedOptimizer):
                                        is_leaf=lambda x: isinstance(x, tuple))
         return new_params, FusedAdamState(count, new_m, new_v)
 
-    def _step_fused(self, state, grads, params, count, lr, rc1, rc2,
-                    inv_scale, wd):
-        fl = self.flattener_for(params)
-        flat_g = fl.flatten(grads)
-        flat_p = fl.flatten(params)
-        scalars = jnp.stack([lr, jnp.float32(self.beta1),
-                             jnp.float32(self.beta2), jnp.float32(self.eps),
-                             wd, rc1, rc2, inv_scale]).reshape(1, 8)
-        outs = kernels.fused_adam_flat(
-            flat_g, flat_p, state.m, state.v, scalars,
-            adam_w_mode=self.adam_w_mode, model_dtype=self.model_dtype)
-        if self.model_dtype is not None:
-            flat_p, m, v, flat_model = outs
-            return (fl.unflatten(flat_model, dtype=self.model_dtype),
-                    FusedAdamState(count, m, v))
-        flat_p, m, v = outs
-        return fl.unflatten(flat_p), FusedAdamState(count, m, v)
+    def step_flat(self, state, flat_grads, *, scale=1.0, lr=None):
+        """Flat-native Adam(W) (the ``multi_tensor_adam.cu`` AdamFunctor math
+        as one XLA elementwise fusion over the permanently-flat buffers)."""
+        count = state.count + 1
+        lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
+                         jnp.float32)
+        rc1, rc2 = self._corrections(count)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+
+        g = flat_grads.astype(jnp.float32) * inv_scale
+        p = state.master
+        if not self.adam_w_mode:
+            g = g + wd * p          # classic L2 (ADAM_MODE_0)
+        m = b1 * state.m + (1.0 - b1) * g
+        v = b2 * state.v + (1.0 - b2) * g * g
+        u = (m * rc1) / (jnp.sqrt(v * rc2) + eps)
+        if self.adam_w_mode:
+            u = u + wd * p          # decoupled decay (ADAM_MODE_1)
+        return FusedAdamState(count, m, v, p - lr * u)

@@ -16,12 +16,14 @@ import jax.numpy as jnp
 
 from ._base import FusedOptimizer, tree_zeros_f32, resolve, _f32, global_l2norm
 from ..multi_tensor_apply import kernels
+from ..multi_tensor_apply.flattener import LANE
 
 
 class FusedLAMBState(NamedTuple):
     count: jnp.ndarray
     m: Any
     v: Any
+    master: Any = None   # fused impl: flat fp32 master params (authoritative)
 
 
 class FusedLAMB(FusedOptimizer):
@@ -49,7 +51,8 @@ class FusedLAMB(FusedOptimizer):
             # (jit donate_argnums) is an aliasing error on the TPU backend
             return FusedLAMBState(jnp.zeros((), jnp.int32),
                                   jnp.zeros((fl.total,), jnp.float32),
-                                  jnp.zeros((fl.total,), jnp.float32))
+                                  jnp.zeros((fl.total,), jnp.float32),
+                                  fl.flatten(params))
         return FusedLAMBState(jnp.zeros((), jnp.int32), tree_zeros_f32(params),
                               tree_zeros_f32(params))
 
@@ -60,24 +63,31 @@ class FusedLAMB(FusedOptimizer):
             return jnp.ones((), jnp.float32)
         return 1.0 / jnp.maximum(1.0, gnorm / self.max_grad_norm)
 
-    def step(self, state, grads, params, *, scale=1.0, lr=None):
+    def _prep(self, state, lr):
         count = state.count + 1
         lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
                          jnp.float32)
-        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
-        wd = jnp.asarray(self.weight_decay, jnp.float32)
-        b1, b2, eps = self.beta1, self.beta2, self.eps
-        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        b1, b2 = self.beta1, self.beta2
         if self.bias_correction:
             t = count.astype(jnp.float32)
             rc1 = 1.0 / (1.0 - b1 ** t)
             rc2 = 1.0 / (1.0 - b2 ** t)
         else:
             rc1 = rc2 = jnp.ones((), jnp.float32)
+        return count, lr, rc1, rc2
 
+    def step(self, state, grads, params, *, scale=1.0, lr=None):
         if self.impl == "fused":
-            return self._step_fused(state, grads, params, count, lr, rc1, rc2,
-                                    inv_scale, wd, beta3)
+            fl = self.flattener_for(params)
+            new_state = self.step_flat(state, fl.flatten(grads), scale=scale,
+                                       lr=lr)
+            return fl.unflatten(new_state.master), new_state
+
+        count, lr, rc1, rc2 = self._prep(state, lr)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
 
         # global grad norm over *unscaled* grads (fused_lamb.py:123-135)
         gnorm = global_l2norm(grads) * inv_scale
@@ -110,28 +120,41 @@ class FusedLAMB(FusedOptimizer):
         new_v = jax.tree_util.tree_map(lambda o: o[2], out, is_leaf=is_t)
         return new_params, FusedLAMBState(count, new_m, new_v)
 
-    def _step_fused(self, state, grads, params, count, lr, rc1, rc2,
-                    inv_scale, wd, beta3):
-        fl = self.flattener_for(params)
-        flat_g = fl.flatten(grads)
-        flat_p = fl.flatten(params)
-        gnorm = kernels.multi_tensor_l2norm(flat_g) * inv_scale
-        clip = self._clip_coeff(gnorm)
-        scalars = jnp.stack([jnp.float32(self.beta1), jnp.float32(self.beta2),
-                             jnp.float32(self.eps), wd, rc1, rc2, clip,
-                             inv_scale, jnp.asarray(beta3, jnp.float32)
-                             ]).reshape(1, 9)
-        flat_u, m, v = kernels.fused_lamb_stage1_flat(
-            flat_g, flat_p, state.m, state.v, scalars,
-            adam_w_mode=self.adam_w_mode)
-        # stage 2: per-tensor trust ratios via static segment reduction
-        w_norm = jnp.sqrt(fl.per_tensor_sumsq(flat_p))
-        u_norm = jnp.sqrt(fl.per_tensor_sumsq(flat_u))
+    def step_flat(self, state, flat_grads, *, scale=1.0, lr=None):
+        """Flat-native two-stage LAMB over the permanently-flat buffers.
+
+        Stage 1 (the ``LAMBStage1Functor`` math) runs as one XLA elementwise
+        fusion; per-tensor ``(w, u)`` norms come from the flattener's static
+        row-range reductions; stage 2 applies the trust-ratio-scaled update
+        with the per-tensor ratio broadcast by row (``LAMBStage2Functor``).
+        The global-grad-norm clip uses the Pallas l2norm kernel (measured
+        faster than the XLA reduce; PERF_NOTES.md)."""
+        count, lr, rc1, rc2 = self._prep(state, lr)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+
+        g = flat_grads.astype(jnp.float32) * inv_scale
+        gnorm = kernels.multi_tensor_l2norm(g)
+        g = g * self._clip_coeff(gnorm)
+        p = state.master
+        if not self.adam_w_mode:
+            g = g + wd * p
+        m = b1 * state.m + beta3 * g
+        v = b2 * state.v + (1.0 - b2) * g * g
+        u = (m * rc1) / (jnp.sqrt(v * rc2) + eps)
+        if self.adam_w_mode:
+            u = u + wd * p
+
+        # stage 2: per-tensor trust ratios via static row-range reductions
+        fl = self.flattener
+        w_norm = jnp.sqrt(fl.per_tensor_sumsq(p))
+        u_norm = jnp.sqrt(fl.per_tensor_sumsq(u))
         ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         if not self.use_nvlamb and self.weight_decay == 0.0:
             ratio = jnp.ones_like(ratio)
         ratio_rows = fl.broadcast_rows(ratio)                 # (rows,)
-        u_rows = flat_u.reshape(-1, 128)
-        p_new = flat_p.reshape(u_rows.shape) - lr * ratio_rows[:, None] * u_rows
-        return fl.unflatten(p_new.reshape(flat_p.shape)), \
-            FusedLAMBState(count, m, v)
+        p_new = (p.reshape(-1, LANE)
+                 - lr * ratio_rows[:, None] * u.reshape(-1, LANE))
+        return FusedLAMBState(count, m, v, p_new.reshape(p.shape))

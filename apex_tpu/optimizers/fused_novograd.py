@@ -22,6 +22,7 @@ class FusedNovoGradState(NamedTuple):
     count: jnp.ndarray
     m: Any            # pytree of f32, like params
     v: Any            # pytree of f32 scalars (per tensor)
+    master: Any = None   # fused impl: flat fp32 master params (authoritative)
 
 
 class FusedNovoGrad(FusedOptimizer):
@@ -48,13 +49,20 @@ class FusedNovoGrad(FusedOptimizer):
             return FusedNovoGradState(
                 jnp.zeros((), jnp.int32),
                 jnp.zeros((fl.total,), jnp.float32),
-                jnp.zeros((fl.num_leaves,), jnp.float32))
+                jnp.zeros((fl.num_leaves,), jnp.float32),
+                fl.flatten(params))
         m = tree_zeros_f32(params)
         v = jax.tree_util.tree_map(
             lambda p: jnp.zeros((), jnp.float32), params)
         return FusedNovoGradState(jnp.zeros((), jnp.int32), m, v)
 
     def step(self, state, grads, params, *, scale=1.0, lr=None):
+        if self.impl == "fused":
+            fl = self.flattener_for(params)
+            new_state = self.step_flat(state, fl.flatten(grads), scale=scale,
+                                       lr=lr)
+            return fl.unflatten(new_state.master), new_state
+
         count = state.count + 1
         lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
                          jnp.float32)
@@ -63,10 +71,6 @@ class FusedNovoGrad(FusedOptimizer):
         b1, b2, eps = self.beta1, self.beta2, self.eps
         beta3 = 1.0 - b1 if self.grad_averaging else 1.0
         first = state.count == 0
-
-        if self.impl == "fused":
-            return self._step_fused(state, grads, params, count, lr,
-                                    inv_scale, wd, beta3, first)
 
         def upd(g, p, m, v):
             g = _f32(g) * inv_scale
@@ -97,16 +101,23 @@ class FusedNovoGrad(FusedOptimizer):
         new_v = jax.tree_util.tree_map(lambda o: o[2], out, is_leaf=is_t)
         return new_params, FusedNovoGradState(count, new_m, new_v)
 
-    def _step_fused(self, state, grads, params, count, lr, inv_scale, wd,
-                    beta3, first):
-        """Flat-buffer path: per-layer norms via the flattener's static
-        segment reductions (the ``multi_tensor_novograd.cu`` per-tensor ``v``
-        becomes a (num_leaves,) vector); the elementwise chain runs over one
-        contiguous buffer, fused by XLA into a single pass like LAMB stage 2.
+    def step_flat(self, state, flat_grads, *, scale=1.0, lr=None):
+        """Flat-native path: per-layer norms via the flattener's static
+        row-range reductions (the ``multi_tensor_novograd.cu`` per-tensor
+        ``v`` becomes a (num_leaves,) vector); the elementwise chain runs over
+        the permanently-flat buffers, fused by XLA into a single pass.
         """
-        fl = self.flattener_for(params)
-        flat_g = fl.flatten(grads) * inv_scale
-        flat_p = fl.flatten(params)
+        count = state.count + 1
+        lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
+                         jnp.float32)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+        beta3 = 1.0 - self.beta1 if self.grad_averaging else 1.0
+        first = state.count == 0
+
+        fl = self.flattener
+        flat_g = flat_grads.astype(jnp.float32) * inv_scale
+        flat_p = state.master
         b1, b2, eps = self.beta1, self.beta2, self.eps
 
         if self.norm_type == 2:
@@ -130,4 +141,4 @@ class FusedNovoGrad(FusedOptimizer):
         if self.bias_correction:
             u = u / (1.0 - b1 ** count.astype(jnp.float32))
         p_new = flat_p - lr * u
-        return fl.unflatten(p_new), FusedNovoGradState(count, m_new, v_new)
+        return FusedNovoGradState(count, m_new, v_new, p_new)

@@ -14,12 +14,12 @@ import jax
 import jax.numpy as jnp
 
 from ._base import FusedOptimizer, tree_zeros_f32, resolve, _f32
-from ..multi_tensor_apply import kernels
 
 
 class FusedSGDState(NamedTuple):
     count: jnp.ndarray
     momentum: Any
+    master: Any = None   # fused impl: flat fp32 master params (authoritative)
 
 
 class FusedSGD(FusedOptimizer):
@@ -42,33 +42,52 @@ class FusedSGD(FusedOptimizer):
         if self.impl == "fused":
             fl = self.flattener_for(params)
             return FusedSGDState(jnp.zeros((), jnp.int32),
-                                 jnp.zeros((fl.total,), jnp.float32))
+                                 jnp.zeros((fl.total,), jnp.float32),
+                                 fl.flatten(params))
         return FusedSGDState(jnp.zeros((), jnp.int32), tree_zeros_f32(params))
 
+    def step_flat(self, state, flat_grads, *, scale=1.0, lr=None):
+        """Flat-native momentum SGD (``multi_tensor_sgd_kernel.cu`` math as
+        one XLA elementwise fusion over the permanently-flat buffers)."""
+        if self.dampening != 0.0:
+            # torch's first-step no-dampening special case needs per-step
+            # branching; use impl="xla" for dampening (rare in practice).
+            raise NotImplementedError(
+                "impl='fused' does not support dampening != 0")
+        count = state.count + 1
+        lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
+                         jnp.float32)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+        mu = self.momentum
+
+        g = flat_grads.astype(jnp.float32) * inv_scale
+        p = state.master
+        if not self.wd_after_momentum:
+            g = g + wd * p
+        if mu != 0.0:
+            mom = mu * state.momentum + g
+            u = g + mu * mom if self.nesterov else mom
+        else:
+            mom = state.momentum
+            u = g
+        if self.wd_after_momentum:
+            u = u + wd * p
+        return FusedSGDState(count, mom, p - lr * u)
+
     def step(self, state, grads, params, *, scale=1.0, lr=None):
+        if self.impl == "fused":
+            fl = self.flattener_for(params)
+            new_state = self.step_flat(state, fl.flatten(grads), scale=scale,
+                                       lr=lr)
+            return fl.unflatten(new_state.master), new_state
+
         count = state.count + 1
         lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
                          jnp.float32)
         inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
         wd = jnp.asarray(self.weight_decay, jnp.float32)
         mu, damp = self.momentum, self.dampening
-
-        if self.impl == "fused":
-            if damp != 0.0:
-                # torch's first-step no-dampening special case needs per-step
-                # branching; use impl="xla" for dampening (rare in practice).
-                raise NotImplementedError(
-                    "impl='fused' does not support dampening != 0")
-            fl = self.flattener_for(params)
-            scalars = jnp.stack([lr, jnp.float32(mu), jnp.float32(damp), wd,
-                                 inv_scale]).reshape(1, 5)
-            flat_g = fl.flatten(grads)
-            flat_p = fl.flatten(params)
-            flat_p, mom = kernels.fused_sgd_flat(
-                flat_g, flat_p, state.momentum, scalars,
-                nesterov=self.nesterov, first_run=False,
-                wd_after_momentum=self.wd_after_momentum)
-            return fl.unflatten(flat_p), FusedSGDState(count, mom)
 
         nesterov, wdam = self.nesterov, self.wd_after_momentum
         first = state.count == 0
