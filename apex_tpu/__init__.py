@@ -14,6 +14,7 @@ hard error in the absence of the Pallas fast path (cf. the reference's
 """
 
 from . import amp
+from . import checkpoint
 from . import fp16_utils
 from . import multi_tensor_apply
 from . import optimizers
@@ -22,5 +23,6 @@ from . import parallel
 from . import mlp
 from . import models
 from . import contrib
+from . import pyprof
 
 __version__ = "0.1.0"

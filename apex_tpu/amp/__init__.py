@@ -30,6 +30,7 @@ from .frontend import (
     initialize,
     scale_loss,
     amp_step,
+    amp_step_multi,
     state_dict,
     load_state_dict,
     AmpState,

@@ -138,25 +138,48 @@ def amp_step(amp_state: AmpState, grads, *, loss_id: int = 0, lr=None):
     Mirrors ``_post_amp_backward`` + patched ``step``
     (_process_optimizer.py:142-202,354-369, handle.py:121-154) with the
     control flow expressed as data (lax/where) so it jits.
-    Returns a new AmpState.
+    Returns a new AmpState.  (Single-loss special case of
+    :func:`amp_step_multi`.)
+    """
+    return amp_step_multi(amp_state, [(grads, loss_id)], lr=lr)
+
+
+def amp_step_multi(amp_state: AmpState, grads_and_ids, *, lr=None):
+    """Multi-loss pipeline: several backward passes, each scaled by its own
+    loss_id scaler, accumulated into ONE optimizer step (the reference's
+    num_losses>1 flow — ``scale_loss(loss, opt, loss_id=i)`` per loss, then a
+    single ``optimizer.step()``; handle.py:16-158 + scaler.py:161-193's
+    ``unscale_with_stashed`` accumulation).
+
+    ``grads_and_ids``: sequence of (grads_pytree, loss_id).  The step is
+    skipped if ANY loss overflowed; each scaler updates from its own
+    overflow flag.  Returns a new AmpState.
     """
     if amp_state.optimizer is None:
-        raise RuntimeError("amp_step requires an optimizer passed to initialize()")
-    sc = amp_state.scalers[loss_id]
-    grads32, finite = _scaler.unscale(sc, grads)
+        raise RuntimeError("amp_step_multi requires an optimizer passed to "
+                           "initialize()")
+    total32 = None
+    finites = {}
+    for grads, loss_id in grads_and_ids:
+        g32, finite = _scaler.unscale(amp_state.scalers[loss_id], grads)
+        finites[loss_id] = (finites[loss_id] & finite
+                            if loss_id in finites else finite)
+        total32 = g32 if total32 is None else jax.tree_util.tree_map(
+            jnp.add, total32, g32)
+    all_finite = None
+    for f in finites.values():
+        all_finite = f if all_finite is None else (all_finite & f)
 
     masters = (amp_state.master_params if amp_state.master_params is not None
                else amp_state.model_params)
     new_masters, new_opt_state = amp_state.optimizer.step(
-        amp_state.opt_state, grads32, masters, lr=lr)
-
-    # overflow => keep old params AND old optimizer state
-    new_masters = _scaler.apply_if_finite(finite, new_masters, masters)
-    new_opt_state = _scaler.apply_if_finite(finite, new_opt_state,
+        amp_state.opt_state, total32, masters, lr=lr)
+    new_masters = _scaler.apply_if_finite(all_finite, new_masters, masters)
+    new_opt_state = _scaler.apply_if_finite(all_finite, new_opt_state,
                                             amp_state.opt_state)
-    new_sc = _scaler.update(sc, finite)
-    scalers = tuple(new_sc if i == loss_id else s
-                    for i, s in enumerate(amp_state.scalers))
+    scalers = tuple(
+        _scaler.update(s, finites[i]) if i in finites else s
+        for i, s in enumerate(amp_state.scalers))
 
     if amp_state.master_params is not None:
         model_params = _pt.master_to_model(new_masters, amp_state.model_params)

@@ -1,0 +1,87 @@
+"""Unified checkpoint/resume: one file holding (model params, optimizer
+state, amp/scaler state, anything else picklable).
+
+The reference documents the save/restore workflow as a hand-rolled triple —
+model/optimizer/amp state_dicts (README.md:63-110, tested by
+``tests/L0/run_amp/test_checkpointing.py:73-240``) — and the examples save
+torch checkpoints per epoch (``examples/imagenet/main_amp.py:252-261``).
+Here that workflow is one pair of functions over arbitrary pytrees:
+
+    from apex_tpu import checkpoint
+    checkpoint.save("ckpt.pkl", step=step, amp=amp.state_dict(st),
+                    model=st.model_params, masters=st.master_params,
+                    opt=st.opt_state, bn=bn_state)
+    ckpt = checkpoint.load("ckpt.pkl")          # dict of numpy pytrees
+
+Arrays come back as numpy (host) arrays; feed them to ``jax.device_put`` /
+``amp.load_state_dict`` / your train-state constructor.  ``save`` is atomic
+(write to temp + rename) so a preempted save never corrupts the previous
+checkpoint — the failure-handling posture of SURVEY §5.4.
+
+Precision portability: pass ``amp.AmpState.params_for_eval()`` (fp32 view)
+as the model entry to reproduce the reference's O2 state_dict hook
+(``_initialize.py:133-142``), or save ``model_params`` as-is for an exact
+resume.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def _to_host(tree):
+    """Device arrays -> numpy (leaves that aren't arrays pass through)."""
+    def conv(x):
+        if hasattr(x, "dtype") and hasattr(x, "shape"):
+            return np.asarray(jax.device_get(x))
+        return x
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def save(path: str, **entries: Any) -> None:
+    """Atomically write ``entries`` (pytrees of arrays / picklable values)."""
+    payload = {k: _to_host(v) for k, v in entries.items()}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt_tmp_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)       # atomic on POSIX
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load(path: str) -> Dict[str, Any]:
+    """Read a checkpoint written by :func:`save` (numpy pytrees)."""
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def restore_like(template, host_tree):
+    """Device-put ``host_tree`` with the dtypes/shardings of ``template``
+    (leaf-wise).  Shapes must match; dtypes are cast to the template's."""
+    from jax.sharding import NamedSharding
+
+    def put(t, h):
+        arr = np.asarray(h)
+        if tuple(arr.shape) != tuple(t.shape):
+            raise ValueError(
+                f"checkpoint leaf shape {arr.shape} != template {t.shape}")
+        sh = getattr(t, "sharding", None)
+        # only commit to an explicit mesh sharding; a plain single-device
+        # placement would pin the restored array and fight jit's automatic
+        # replication against sharded batch inputs
+        if not isinstance(sh, NamedSharding):
+            sh = None
+        return jax.device_put(arr.astype(t.dtype), sh)
+    return jax.tree_util.tree_map(put, template, host_tree)

@@ -1,0 +1,115 @@
+"""Profiling shim — the ``apex.pyprof`` analog over jax's profiler.
+
+The reference's pyprof has three parts (SURVEY §5.1): (a) ``nvtx.init()``
+monkey-patches every torch fn to push NVTX ranges encoding op/args/shapes
+(``apex/pyprof/nvtx/nvmarker.py:27-222``); (b) ``parse`` reads nvprof SQLite;
+(c) ``prof`` maps kernels to layers and computes FLOPs/bytes.
+
+On TPU, (b) and (c) are owned by XLA + Perfetto/TensorBoard: a captured
+trace already attributes time to named HLO ops with cost-analysis FLOPs.
+What remains useful — and what this module provides — is the *annotation
+API*: name regions of your step so they show up in the trace, plus
+start/stop/trace helpers the examples call with ``--prof``.
+
+    from apex_tpu import pyprof
+    pyprof.init()                        # banner + no-op patching (parity)
+    with pyprof.annotate("fwd"):         # named range in the trace
+        loss = model(x)
+    pyprof.start_trace("/tmp/trace")     # Perfetto/TensorBoard capture
+    ... steps ...
+    pyprof.stop_trace()
+
+``annotate`` works both inside jit (becomes a ``jax.named_scope`` on the
+lowered HLO) and outside (becomes a ``TraceAnnotation`` wall-time range).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+class _State:
+    initialized = False
+    trace_dir = None
+
+
+_state = _State()   # process-wide, like the reference's patched namespaces
+
+
+def init(enable_function_stack: bool = False) -> None:
+    """API-parity entry point (``pyprof.nvtx.init``, nvmarker.py:206-222).
+
+    The reference monkey-patches the framework so every op pushes a marker;
+    under jit every HLO op is already named by its traceback — there is
+    nothing to patch.  This prints the analogous banner and records that
+    profiling was requested (``is_initialized``)."""
+    print("apex_tpu.pyprof: jax.profiler owns op-level attribution on TPU "
+          "(XLA names every HLO from its Python traceback); use "
+          "annotate()/start_trace()/stop_trace() for custom ranges.")
+    _state.initialized = True
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+@contextlib.contextmanager
+def annotate(name: str, **attrs):
+    """Named range visible in profiler traces.
+
+    Inside a jit trace this contributes a ``jax.named_scope`` (op-name
+    prefix in the HLO/XPlane); outside it opens a host ``TraceAnnotation``
+    wall-clock range.  ``attrs`` are appended to the name (the reference
+    encodes args into the NVTX message, nvmarker.py:46-108)."""
+    if attrs:
+        name = name + "|" + ",".join(f"{k}={v}" for k, v in attrs.items())
+    with jax.named_scope(name):
+        try:
+            anno = jax.profiler.TraceAnnotation(name)
+        except Exception:           # pragma: no cover - API drift safety
+            anno = contextlib.nullcontext()
+        with anno:
+            yield
+
+
+def annotate_function(fn=None, *, name: str | None = None):
+    """Decorator form of :func:`annotate` (the reference's per-function
+    wrapper, nvmarker.py:110-130)."""
+    import functools
+
+    def deco(f):
+        label = name or getattr(f, "__name__", "fn")
+
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            with annotate(label):
+                return f(*args, **kwargs)
+        return wrapped
+    return deco(fn) if fn is not None else deco
+
+
+def start_trace(log_dir: str) -> None:
+    """Begin a profiler capture (TensorBoard/Perfetto-readable)."""
+    jax.profiler.start_trace(log_dir)
+    _state.trace_dir = log_dir
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Scoped capture: ``with pyprof.trace(dir): ...steps...``"""
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+def server(port: int = 9999):
+    """Live-attach profiling server (``jax.profiler.start_server``) — the
+    'nvprof attach' analog; connect from TensorBoard's profile tab."""
+    return jax.profiler.start_server(port)
