@@ -7,8 +7,9 @@ backward (``cuda_layer_norm:101``).  Two paths:
 
 - XLA path (default): jnp math under ``jax.custom_vjp`` with the same
   residuals; XLA fuses it into ~two passes.
-- Pallas path (``apex_tpu.ops.layer_norm``): a single-pass blockwise kernel
-  for long rows — enabled with ``use_pallas=True`` on TPU.
+- Pallas path (``apex_tpu.ops.layer_norm``): blockwise kernel computing each
+  row's stats in one HBM read — ``use_pallas=True`` on the module or the
+  ``fused_layer_norm[_affine](..., use_pallas=True)`` functions.
 """
 from __future__ import annotations
 
@@ -31,8 +32,20 @@ def _norm_axes(x, normalized_shape):
     return tuple(range(x.ndim - n, x.ndim))
 
 
+def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5,
+                            *, use_pallas=False):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    normalized_shape = tuple(normalized_shape)   # hashable nondiff argnum
+    if use_pallas:
+        from ..ops.layer_norm import layer_norm_pallas
+        return layer_norm_pallas(x, weight, bias, normalized_shape, eps)
+    return _fused_layer_norm_affine_xla(x, weight, bias, normalized_shape,
+                                        eps)
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5):
+def _fused_layer_norm_affine_xla(x, weight, bias, normalized_shape, eps=1e-5):
     out, _, _ = _ln_fwd(x, weight, bias, normalized_shape, eps)
     return out
 
@@ -77,25 +90,29 @@ def _ln_bwd_vjp(normalized_shape, eps, res, g):
     return dx.astype(x.dtype), dw, db
 
 
-fused_layer_norm_affine.defvjp(_ln_fwd_vjp, _ln_bwd_vjp)
+_fused_layer_norm_affine_xla.defvjp(_ln_fwd_vjp, _ln_bwd_vjp)
 
 
-def fused_layer_norm(x, normalized_shape, eps=1e-5):
+def fused_layer_norm(x, normalized_shape, eps=1e-5, *, use_pallas=False):
     """Non-affine variant (``FusedLayerNormFunction``, fused_layer_norm.py:39)."""
-    return fused_layer_norm_affine(x, None, None, normalized_shape, eps)
+    return fused_layer_norm_affine(x, None, None, normalized_shape, eps,
+                                   use_pallas=use_pallas)
 
 
 class FusedLayerNorm:
     """Module-style wrapper mirroring ``apex.normalization.FusedLayerNorm``
     (fused_layer_norm.py:70-167).  Params are created by ``init`` and passed
-    to ``apply`` — flax-style, so it nests in any pytree-based model."""
+    to ``apply`` — flax-style, so it nests in any pytree-based model.
+    ``use_pallas=True`` selects the Pallas kernel (ops/layer_norm.py)."""
 
-    def __init__(self, normalized_shape, eps=1e-5, elementwise_affine=True):
+    def __init__(self, normalized_shape, eps=1e-5, elementwise_affine=True,
+                 use_pallas=False):
         if isinstance(normalized_shape, int):
             normalized_shape = (normalized_shape,)
         self.normalized_shape = tuple(normalized_shape)
         self.eps = eps
         self.elementwise_affine = elementwise_affine
+        self.use_pallas = use_pallas
 
     def init(self, rng=None):
         if not self.elementwise_affine:
@@ -107,7 +124,8 @@ class FusedLayerNorm:
         if self.elementwise_affine:
             return fused_layer_norm_affine(
                 x, params["weight"], params["bias"], self.normalized_shape,
-                self.eps)
-        return fused_layer_norm(x, self.normalized_shape, self.eps)
+                self.eps, use_pallas=self.use_pallas)
+        return fused_layer_norm(x, self.normalized_shape, self.eps,
+                                use_pallas=self.use_pallas)
 
     __call__ = apply

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for structured ops (the ``csrc/`` analog).
+
+Unlike the elementwise multi-tensor engine (which measured faster as XLA
+fusions over flat buffers — PERF_NOTES.md §2), the ops here have reduction /
+blocking structure that benefits from explicit kernels: layer norm (the
+``fused_layer_norm_cuda`` analog), with flash attention and fused
+softmax-xentropy living in ``apex_tpu.contrib``.
+"""
+from .layer_norm import layer_norm_pallas, pallas_available
+
+__all__ = ["layer_norm_pallas", "pallas_available"]

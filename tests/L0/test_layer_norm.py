@@ -1,0 +1,115 @@
+"""FusedLayerNorm oracle tests — the analog of
+tests/L0/run_fused_layer_norm/ (FusedLayerNorm vs torch.nn.LayerNorm
+numerics), plus Pallas-vs-XLA path parity (interpret mode on CPU)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torch
+
+from apex_tpu.normalization import (FusedLayerNorm, fused_layer_norm,
+                                    fused_layer_norm_affine)
+
+
+SHAPES = [((4, 16), (16,)), ((2, 3, 33), (33,)), ((5, 128), (128,)),
+          ((2, 4, 8), (4, 8))]
+
+
+@pytest.mark.parametrize("xshape,nshape", SHAPES)
+def test_affine_vs_torch(xshape, nshape):
+    rng = np.random.RandomState(0)
+    x = rng.randn(*xshape).astype(np.float32)
+    w = rng.randn(*nshape).astype(np.float32)
+    b = rng.randn(*nshape).astype(np.float32)
+
+    out = fused_layer_norm_affine(jnp.asarray(x), jnp.asarray(w),
+                                  jnp.asarray(b), nshape)
+    tln = torch.nn.LayerNorm(nshape, eps=1e-5)
+    with torch.no_grad():
+        tln.weight.copy_(torch.tensor(w))
+        tln.bias.copy_(torch.tensor(b))
+    ref = tln(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("xshape,nshape", SHAPES)
+@pytest.mark.parametrize("affine", [True, False])
+def test_pallas_matches_xla_fwd_bwd(xshape, nshape, affine):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(*xshape).astype(np.float32))
+    if affine:
+        w = jnp.asarray(rng.randn(*nshape).astype(np.float32))
+        b = jnp.asarray(rng.randn(*nshape).astype(np.float32))
+    else:
+        w = b = None
+    g = jnp.asarray(rng.randn(*xshape).astype(np.float32))
+
+    def run(use_pallas):
+        def f(x, w, b):
+            out = fused_layer_norm_affine(x, w, b, nshape,
+                                          use_pallas=use_pallas)
+            return jnp.sum(out * g)
+        val, grads = jax.value_and_grad(f, argnums=(0,) + (
+            (1, 2) if affine else ()))(x, w, b)
+        return val, grads
+
+    vx, gx = run(False)
+    vp, gp = run(True)
+    np.testing.assert_allclose(float(vx), float(vp), rtol=1e-5)
+    for a, b2 in zip(jax.tree_util.tree_leaves(gx),
+                     jax.tree_util.tree_leaves(gp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2), atol=2e-5)
+
+
+def test_bwd_vs_torch():
+    rng = np.random.RandomState(2)
+    x = rng.randn(6, 40).astype(np.float32)
+    w = rng.randn(40).astype(np.float32)
+    b = rng.randn(40).astype(np.float32)
+
+    def f(x_, w_, b_):
+        return jnp.sum(fused_layer_norm_affine(x_, w_, b_, (40,)) ** 2)
+
+    dx, dw, db = jax.grad(f, argnums=(0, 1, 2))(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+
+    tx = torch.tensor(x, requires_grad=True)
+    tln = torch.nn.LayerNorm((40,), eps=1e-5)
+    with torch.no_grad():
+        tln.weight.copy_(torch.tensor(w))
+        tln.bias.copy_(torch.tensor(b))
+    (tln(tx) ** 2).sum().backward()
+    np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dw), tln.weight.grad.numpy(),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db), tln.bias.grad.numpy(),
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_module_api_and_bf16(use_pallas):
+    ln = FusedLayerNorm(24, use_pallas=use_pallas)
+    params = ln.init()
+    x = jnp.ones((3, 24), jnp.bfloat16) * 2 + jnp.arange(
+        24, dtype=jnp.bfloat16)
+    out = ln.apply(params, x)
+    assert out.dtype == jnp.bfloat16
+    row = np.asarray(out[0], np.float32)
+    assert abs(row.mean()) < 0.1 and abs(row.std() - 1.0) < 0.1
+    # non-affine
+    ln2 = FusedLayerNorm(24, elementwise_affine=False,
+                         use_pallas=use_pallas)
+    out2 = ln2.apply(ln2.init(), x.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out2).mean(axis=-1), 0.0,
+                               atol=1e-5)
+
+
+def test_jit_and_shape_error():
+    ln = FusedLayerNorm((16,), use_pallas=True)
+    params = ln.init()
+    out = jax.jit(ln.apply)(params, jnp.ones((4, 16)))
+    assert out.shape == (4, 16)
+    with pytest.raises(ValueError):
+        fused_layer_norm(jnp.ones((4, 8)), (16,))
