@@ -1,0 +1,8 @@
+"""apex.RNN analog (reference: ``apex/RNN/models.py:19-54``)."""
+from .rnn import (LSTM, GRU, ReLU, Tanh, mLSTM, RNNContainer,
+                  lstm_cell, gru_cell, rnn_relu_cell, rnn_tanh_cell,
+                  mlstm_cell)
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM", "RNNContainer",
+           "lstm_cell", "gru_cell", "rnn_relu_cell", "rnn_tanh_cell",
+           "mlstm_cell"]

@@ -1,0 +1,237 @@
+"""RNN toolkit — TPU-native rebuild of ``apex/RNN``.
+
+The reference builds RNNs from a per-timestep ``RNNCell`` wrapped by
+``stackedRNN``/``bidirectionalRNN`` containers that python-loop over time
+and layers with hidden-state mutation (``RNNBackend.py:25,90,232``).  Here
+cells are pure functions and the time loop is ``jax.lax.scan`` (compiled
+once, no per-step dispatch — replacing the reference's fused pointwise
+kernels), layers/directions are static python loops, and hidden state is
+carried functionally.
+
+API parity (``models.py:19-54``): ``LSTM/GRU/ReLU/Tanh/mLSTM(input_size,
+hidden_size, num_layers, bias=True, batch_first=False, dropout=0,
+bidirectional=False, output_size=None)`` — returning a container with
+``init(key) -> params`` and ``apply(params, x, hx=None, rng=None) ->
+(output, final_hidden)``.
+
+Gate layouts match torch (i, f, g, o for LSTM; r, z, n for GRU), so
+torch-trained weights drop in leaf-for-leaf.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------
+# cells (pure; mirror torch.nn._functions.rnn cell math)
+# --------------------------------------------------------------------------
+
+def rnn_tanh_cell(x, hidden, p):
+    (h,) = hidden
+    return (jnp.tanh(x @ p["w_ih"].T + h @ p["w_hh"].T
+                     + p.get("b_ih", 0) + p.get("b_hh", 0)),)
+
+
+def rnn_relu_cell(x, hidden, p):
+    (h,) = hidden
+    return (jax.nn.relu(x @ p["w_ih"].T + h @ p["w_hh"].T
+                        + p.get("b_ih", 0) + p.get("b_hh", 0)),)
+
+
+def lstm_cell(x, hidden, p):
+    h, c = hidden
+    gates = (x @ p["w_ih"].T + h @ p["w_hh"].T
+             + p.get("b_ih", 0) + p.get("b_hh", 0))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    return jnp.tanh(c_new) * o, c_new
+
+
+def gru_cell(x, hidden, p):
+    (h,) = hidden
+    gi = x @ p["w_ih"].T + p.get("b_ih", 0)
+    gh = h @ p["w_hh"].T + p.get("b_hh", 0)
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    return ((1.0 - z) * n + z * h,)
+
+
+def mlstm_cell(x, hidden, p):
+    """Multiplicative LSTM (``cells.py:55-83``): the hidden entering the
+    gates is modulated by ``m = (W_mih x) * (W_mhh h)``."""
+    h, c = hidden
+    m = (x @ p["w_mih"].T) * (h @ p["w_mhh"].T)
+    gates = (x @ p["w_ih"].T + p.get("b_ih", 0)
+             + m @ p["w_hh"].T + p.get("b_hh", 0))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    return jnp.tanh(c_new) * o, c_new
+
+
+@dataclasses.dataclass(frozen=True)
+class _CellSpec:
+    fn: Callable
+    gate_multiplier: int
+    n_hidden_states: int
+    multiplicative: bool = False
+
+
+_CELLS = {
+    "lstm": _CellSpec(lstm_cell, 4, 2),
+    "gru": _CellSpec(gru_cell, 3, 1),
+    "relu": _CellSpec(rnn_relu_cell, 1, 1),
+    "tanh": _CellSpec(rnn_tanh_cell, 1, 1),
+    "mlstm": _CellSpec(mlstm_cell, 4, 2, multiplicative=True),
+}
+
+
+# --------------------------------------------------------------------------
+# container (stackedRNN / bidirectionalRNN analog)
+# --------------------------------------------------------------------------
+
+class RNNContainer:
+    """Stacked (optionally bidirectional) RNN over a cell spec — the
+    functional union of ``stackedRNN`` (RNNBackend.py:90) and
+    ``bidirectionalRNN`` (RNNBackend.py:25)."""
+
+    def __init__(self, cell: str, input_size: int, hidden_size: int,
+                 num_layers: int, bias=True, batch_first=False, dropout=0.0,
+                 bidirectional=False, output_size: Optional[int] = None):
+        if cell not in _CELLS:
+            raise ValueError(f"unknown cell {cell!r}; have {sorted(_CELLS)}")
+        self.cell = _CELLS[cell]
+        self.cell_name = cell
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bias = bias
+        self.batch_first = batch_first
+        self.dropout = float(dropout)
+        self.bidirectional = bidirectional
+        # output projection (RNNBackend RNNCell.w_ho when output_size is set)
+        self.output_size = output_size if output_size is not None \
+            else hidden_size
+        self.proj = output_size is not None and output_size != hidden_size
+        self.num_directions = 2 if bidirectional else 1
+
+    # -- params --------------------------------------------------------------
+
+    def _layer_params(self, key, in_size):
+        spec = self.cell
+        gm = spec.gate_multiplier
+        h = self.hidden_size
+        std = 1.0 / math.sqrt(h)     # torch RNN reset_parameters
+        ks = jax.random.split(key, 6)
+        u = lambda k, shape: jax.random.uniform(k, shape, jnp.float32,
+                                                -std, std)
+        p = {"w_ih": u(ks[0], (gm * h, in_size)),
+             "w_hh": u(ks[1], (gm * h, h))}
+        if self.bias:
+            p["b_ih"] = u(ks[2], (gm * h,))
+            p["b_hh"] = u(ks[3], (gm * h,))
+        if spec.multiplicative:
+            p["w_mih"] = u(ks[4], (h, in_size))
+            p["w_mhh"] = u(ks[5], (h, h))
+        return p
+
+    def init(self, key) -> dict:
+        params = {}
+        out_of_layer = self.output_size * self.num_directions
+        for layer in range(self.num_layers):
+            in_size = self.input_size if layer == 0 else out_of_layer
+            for d in range(self.num_directions):
+                key, sub = jax.random.split(key)
+                name = f"layer{layer}" + ("_rev" if d else "")
+                params[name] = self._layer_params(sub, in_size)
+                if self.proj:
+                    key, sub = jax.random.split(key)
+                    std = 1.0 / math.sqrt(self.hidden_size)
+                    params[name]["w_ho"] = jax.random.uniform(
+                        sub, (self.output_size, self.hidden_size),
+                        jnp.float32, -std, std)
+        return params
+
+    # -- forward -------------------------------------------------------------
+
+    def _zero_hidden(self, batch):
+        return tuple(jnp.zeros((batch, self.hidden_size), jnp.float32)
+                     for _ in range(self.cell.n_hidden_states))
+
+    def _scan_direction(self, p, x, h0, reverse):
+        """x (T, B, F) -> (T, B, out), final hidden tuple."""
+        cell_fn = self.cell.fn
+
+        def step(hidden, xt):
+            new = cell_fn(xt, hidden, p)
+            out = new[0]
+            if self.proj:
+                out = out @ p["w_ho"].T
+            return tuple(new), out
+
+        hidden, ys = jax.lax.scan(step, h0, x, reverse=reverse)
+        return ys, hidden
+
+    def apply(self, params, x, hx=None, *, rng=None):
+        """x: (T, B, input) — or (B, T, input) with batch_first.  Returns
+        (output (T|B, ..., out*dirs), final_hidden list per layer*dir).
+        ``rng`` enables inter-layer dropout (RNNBackend.py:90's dropout)."""
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)
+        T, B = x.shape[:2]
+        finals = []
+        out = x
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(self.num_directions):
+                name = f"layer{layer}" + ("_rev" if d else "")
+                h0 = (hx[len(finals)] if hx is not None
+                      else self._zero_hidden(B))
+                ys, hT = self._scan_direction(params[name], out, h0,
+                                              reverse=bool(d))
+                outs.append(ys)
+                finals.append(hT)
+            out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, -1)
+            if (self.dropout > 0 and rng is not None
+                    and layer < self.num_layers - 1):
+                rng, sub = jax.random.split(rng)
+                keep = jax.random.bernoulli(sub, 1.0 - self.dropout,
+                                            out.shape)
+                out = out * keep / (1.0 - self.dropout)
+        if self.batch_first:
+            out = jnp.swapaxes(out, 0, 1)
+        return out, finals
+
+    __call__ = apply
+
+
+def _model(cell):
+    def make(input_size, hidden_size, num_layers, bias=True,
+             batch_first=False, dropout=0, bidirectional=False,
+             output_size=None):
+        return RNNContainer(cell, input_size, hidden_size, num_layers,
+                            bias=bias, batch_first=batch_first,
+                            dropout=dropout, bidirectional=bidirectional,
+                            output_size=output_size)
+    make.__name__ = cell.upper()
+    make.__doc__ = (f"apex.RNN.models.{cell.upper()} analog "
+                    "(models.py:19-54); returns an RNNContainer.")
+    return make
+
+
+LSTM = _model("lstm")
+GRU = _model("gru")
+ReLU = _model("relu")
+Tanh = _model("tanh")
+mLSTM = _model("mlstm")

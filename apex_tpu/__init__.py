@@ -24,5 +24,7 @@ from . import mlp
 from . import models
 from . import contrib
 from . import pyprof
+from . import interop
+from . import RNN
 
 __version__ = "0.1.0"

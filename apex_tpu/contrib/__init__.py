@@ -8,5 +8,6 @@ selected at call time.
 from . import xentropy
 from . import multihead_attn
 from . import optimizers
+from . import sparsity
 
-__all__ = ["xentropy", "multihead_attn", "optimizers"]
+__all__ = ["xentropy", "multihead_attn", "optimizers", "sparsity"]

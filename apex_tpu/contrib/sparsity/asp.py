@@ -1,0 +1,166 @@
+"""ASP — automatic 2:4 structured sparsity over param pytrees.
+
+Functional re-design of ``apex/contrib/sparsity/asp.py:21-155``.  The
+reference is a class-level singleton that registers mask buffers on modules
+and monkey-patches ``optimizer.step`` to multiply grads by the mask before
+the step and params after it (``init_optimizer_for_pruning``, ``:127-153``).
+In a pytree world the same contract is explicit state:
+
+    asp = ASP()                                   # pattern + layer policy
+    asp.init_model_for_pruning(params)            # record eligibility
+    masks = asp.compute_sparse_masks(params)      # mask pytree (enable)
+    params = asp.prune(params, masks)             # apply masks once
+    opt = asp.wrap_optimizer(FusedAdam(...), masks)   # step keeps sparsity
+    ... train with opt exactly as before ...
+
+Checkpoint continuity (the reference's 3-part checkpoint tests): masks are
+a plain pytree — save them with ``apex_tpu.checkpoint`` alongside params,
+or recompute from the loaded (already pruned) params (a pruned weight's
+mask recomputes to itself: the kept pair is still the largest).
+
+Eligibility mirrors the reference's whitelist + divisibility gates
+(``init_model_for_pruning``'s ndim/size checks): leaves with ndim >= 2
+whose contraction dim (axis -2) is a multiple of 4 and whose output dim is
+a multiple of 8, filtered by ``allowed_layer_names`` / ``disallowed_layer
+_names`` substring match on the pytree path (the module-name analog).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .sparse_masklib import create_mask
+from ...utils.pytree import path_str as _path_str
+
+
+class ASP:
+    """Instance-based ASP (the reference's classmethod singleton, made
+    functional).  One instance = one sparsity policy."""
+
+    def __init__(self, mask_calculator: str | Callable = "m4n2_1d",
+                 verbosity: int = 0,
+                 allowed_layer_names: Optional[Sequence[str]] = None,
+                 disallowed_layer_names: Sequence[str] = (),
+                 custom_eligible: Optional[Callable] = None,
+                 axis: int = -2):
+        self.mask_calculator = mask_calculator
+        self.verbosity = verbosity
+        self.allowed = (tuple(allowed_layer_names)
+                        if allowed_layer_names is not None else None)
+        self.disallowed = tuple(disallowed_layer_names)
+        self.custom_eligible = custom_eligible
+        self.axis = axis
+        self._eligible_paths: Optional[frozenset] = None
+
+    # -- eligibility (init_model_for_pruning, asp.py:29-126) -----------------
+
+    def _default_eligible(self, name: str, leaf) -> bool:
+        if leaf.ndim < 2 or not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return False
+        # TC-divisibility analog (asp.py:101-106): pruned (contraction) dim
+        # % 4, output dim % 8 — below that, 2:4 buys nothing on the MXU
+        # either.  The output dim is the trailing dim NOT being pruned.
+        prune_ax = self.axis % leaf.ndim
+        out_ax = leaf.ndim - 1 if prune_ax != leaf.ndim - 1 else leaf.ndim - 2
+        if leaf.shape[prune_ax] % 4 != 0 or leaf.shape[out_ax] % 8 != 0:
+            return False
+        if self.allowed is not None and not any(
+                a in name for a in self.allowed):
+            return False
+        if any(d in name for d in self.disallowed):
+            return False
+        return True
+
+    def init_model_for_pruning(self, params) -> "ASP":
+        """Record which leaves are sparsifiable.  Idempotent; returns self."""
+        eligible = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            name = _path_str(path)
+            pred = self.custom_eligible or self._default_eligible
+            if pred(name, leaf):
+                eligible.append(name)
+                if self.verbosity >= 3:
+                    print(f"[ASP] sparsifying {name} {leaf.shape}")
+            elif self.verbosity >= 3:
+                print(f"[ASP] NOT sparsifying {name} "
+                      f"{getattr(leaf, 'shape', ())}")
+        self._eligible_paths = frozenset(eligible)
+        return self
+
+    def _require_init(self):
+        if self._eligible_paths is None:
+            raise RuntimeError("call ASP.init_model_for_pruning(params) "
+                               "first (asp.py:127-130 ordering contract)")
+
+    # -- masks (compute_sparse_masks, asp.py:155) ----------------------------
+
+    def compute_sparse_masks(self, params):
+        """Mask pytree: m:n mask for eligible leaves, ones elsewhere."""
+        self._require_init()
+
+        def mk(path, leaf):
+            if _path_str(path) in self._eligible_paths:
+                return create_mask(leaf, self.mask_calculator,
+                                   axis=self.axis)
+            return jnp.ones_like(leaf)
+        return jax.tree_util.tree_map_with_path(mk, params)
+
+    @staticmethod
+    def prune(tree, masks):
+        """Apply masks (to params or grads)."""
+        return jax.tree_util.tree_map(lambda t, m: t * m.astype(t.dtype),
+                                      tree, masks)
+
+    # -- optimizer wrap (init_optimizer_for_pruning, asp.py:127-153) ---------
+
+    def wrap_optimizer(self, optimizer, masks) -> "SparseOptimizer":
+        """Wrapped optimizer whose step multiplies grads by the mask before
+        the update and params after it — the monkey-patched ``__step``."""
+        self._require_init()
+        return SparseOptimizer(optimizer, masks)
+
+
+class SparseOptimizer:
+    """Drop-in wrapper: same ``init/step`` contract as the fused optimizers,
+    masking grads pre-step and params post-step (asp.py:139-152).  Like the
+    reference under amp (where only ``p`` and ``p.grad`` are masked, not the
+    fp32 masters), any master weights inside the wrapped optimizer's state
+    stay dense; the params every forward sees are exactly 2:4 sparse."""
+
+    def __init__(self, optimizer, masks):
+        self.optimizer = optimizer
+        self.masks = masks
+        self._flat_mask = None
+
+    def __getattr__(self, name):
+        return getattr(self.optimizer, name)
+
+    def init(self, params):
+        return self.optimizer.init(params)
+
+    def step(self, state, grads, params, **kw):
+        grads = ASP.prune(grads, self.masks)
+        new_params, new_state = self.optimizer.step(state, grads, params,
+                                                    **kw)
+        return ASP.prune(new_params, self.masks), new_state
+
+    # optax-style alias (masked; see FusedOptimizer.update)
+    def update(self, grads, state, params):
+        new_params, new_state = self.step(state, grads, params)
+        updates = jax.tree_util.tree_map(lambda n, p: n - p, new_params,
+                                         params)
+        return updates, new_state
+
+    def _mask_flat(self):
+        if self._flat_mask is None:
+            self._flat_mask = self.optimizer.flattener.flatten(self.masks)
+        return self._flat_mask
+
+    def step_flat(self, state, flat_grads, **kw):
+        """Flat-native path keeps the sparsity contract too: masked grads
+        in, masked flat master out."""
+        m = self._mask_flat()
+        new_state = self.optimizer.step_flat(state, flat_grads * m, **kw)
+        return new_state._replace(master=new_state.master * m)

@@ -1,0 +1,79 @@
+"""m:n structured-sparsity mask computation (reference:
+``apex/contrib/sparsity/sparse_masklib.py:145`` ``create_mask``).
+
+The reference enumerates all C(m,n) binary patterns and, per group of m
+consecutive elements, picks the pattern maximising the kept |weight| mass
+(``mn_1d_best``).  That formulation is already matmul-shaped — scores are
+``|w|_groups @ patterns.T`` — so it maps directly onto jnp and runs under
+jit on TPU (the MXU does the scoring).
+
+Axis convention: the reference prunes along the last dim of torch's
+``(out, in)`` weight layout, i.e. the CONTRACTION dim.  JAX kernels are
+``(..., in, out)`` / HWIO, where the contraction dim is axis ``-2`` — so
+``create_mask`` takes an ``axis`` argument and ``ASP`` passes ``-2``.
+"""
+from __future__ import annotations
+
+import functools
+from itertools import permutations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=None)
+def _valid_patterns(m: int, n: int) -> np.ndarray:
+    """All distinct m-length binary vectors with exactly n ones, as (P, m)
+    float32 (``compute_valid_1d_patterns``)."""
+    base = [1.0] * n + [0.0] * (m - n)
+    pats = sorted(set(permutations(base)), reverse=True)
+    return np.asarray(pats, np.float32)
+
+
+def mn_1d_best(matrix: jnp.ndarray, m: int, n: int) -> jnp.ndarray:
+    """Best m:n mask along the LAST axis of a 2-D matrix (``mn_1d_best``).
+    Groups of m consecutive elements keep their n largest-|value| entries
+    (exactly: the pattern with max kept mass).  Ragged tails are zero-padded
+    (padding prefers to be masked, like the reference's ``reshape_1d``)."""
+    pats = jnp.asarray(_valid_patterns(m, n))          # (P, m)
+    r, c = matrix.shape
+    pad = (-c) % m
+    mat = jnp.abs(matrix.astype(jnp.float32))
+    if pad:
+        mat = jnp.pad(mat, ((0, 0), (0, pad)))
+    groups = mat.reshape(-1, m)                        # (G, m)
+    scores = groups @ pats.T                           # (G, P) — MXU
+    best = jnp.argmax(scores, axis=1)                  # (G,)
+    mask = pats[best].reshape(r, c + pad)[:, :c]
+    return mask
+
+
+def m4n2_1d(matrix: jnp.ndarray, density: float = 0.5) -> jnp.ndarray:
+    return mn_1d_best(matrix, 4, 2)
+
+
+_PATTERNS = {"m4n2_1d": m4n2_1d}
+
+
+def create_mask(tensor: jnp.ndarray, pattern: str = "m4n2_1d",
+                density: float = 0.5, axis: int = -2) -> jnp.ndarray:
+    """Mask of ``tensor``'s shape/dtype with the m:n pattern applied along
+    ``axis`` (``create_mask``, sparse_masklib.py:145).  Works for any rank
+    >= 1; other dims are flattened into rows."""
+    if isinstance(pattern, str):
+        if pattern not in _PATTERNS:
+            raise ValueError(f"unknown sparsity pattern {pattern!r}; "
+                             f"have {sorted(_PATTERNS)}")
+        fn = _PATTERNS[pattern]
+    else:
+        fn = pattern
+    if tensor.ndim == 0:
+        raise ValueError("cannot sparsify a scalar")
+    ax = axis % tensor.ndim if tensor.ndim > 1 else 0
+    moved = jnp.moveaxis(tensor, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    mask = fn(flat, density)
+    mask = mask.reshape(moved.shape)
+    return jnp.moveaxis(mask, -1, ax).astype(tensor.dtype)

@@ -1,0 +1,145 @@
+"""torch interop via DLPack — the north-star bridge ("fused optimizers
+exposed through ``apex.optimizers`` via DLPack").
+
+A torch training loop keeps its ``torch.nn`` module; the optimizer state
+and fused update live JAX-side.  Tensors cross the boundary zero-copy via
+DLPack where the buffers are co-located (CPU<->CPU today; torch-XLA<->JAX
+on the same chip where supported), falling back to host copies otherwise.
+
+    import torch
+    from apex_tpu.interop import TorchFusedOptimizer
+    from apex_tpu.optimizers import FusedAdam
+
+    model = torch.nn.Linear(64, 64)
+    opt = TorchFusedOptimizer(model.parameters(), FusedAdam(lr=1e-3))
+    loss = model(x).pow(2).mean()
+    loss.backward()
+    opt.step()            # grads -> DLPack -> fused JAX step -> params
+    opt.zero_grad()
+
+``TorchFusedOptimizer.step`` mirrors the reference's deprecated-contrib
+``step(grads=..., scale=...)`` affordances (``apex/contrib/optimizers/
+fused_adam.py:175``): explicit grads and a loss scale can be passed.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except ImportError as err:  # pragma: no cover
+        raise RuntimeError(
+            "apex_tpu.interop requires torch (CPU build is enough)") from err
+
+
+def from_torch(t) -> jnp.ndarray:
+    """torch.Tensor -> jax array (DLPack zero-copy when co-located)."""
+    torch = _torch()
+    t = t.detach().contiguous()
+    try:
+        return jnp.from_dlpack(t)
+    except Exception:
+        # cross-device / unsupported layout: host round-trip.  torch bf16
+        # has no .numpy(); stage through fp32 and restore the dtype.
+        if t.dtype == torch.bfloat16:
+            return jnp.asarray(t.float().cpu().numpy()).astype(jnp.bfloat16)
+        return jnp.asarray(t.cpu().numpy())
+
+
+def to_torch(x):
+    """jax array -> torch.Tensor (DLPack zero-copy when co-located)."""
+    torch = _torch()
+    try:
+        return torch.from_dlpack(x)
+    except Exception:
+        # torch.from_numpy rejects ml_dtypes bf16; stage through fp32
+        if x.dtype == jnp.bfloat16:
+            arr = np.asarray(jax.device_get(x.astype(jnp.float32)))
+            return torch.from_numpy(arr).to(torch.bfloat16)
+        return torch.from_numpy(np.asarray(jax.device_get(x)))
+
+
+class TorchFusedOptimizer:
+    """Drive an apex_tpu fused optimizer from a torch loop.
+
+    ``params``: iterable of torch Parameters/Tensors (leaves, any shapes).
+    ``optimizer``: any apex_tpu fused optimizer (FusedAdam/LAMB/SGD/...),
+    either impl; state lives JAX-side, keyed to the param list order.
+    """
+
+    def __init__(self, params: Iterable, optimizer):
+        torch = _torch()
+        self._params = [p for p in params]
+        if not self._params:
+            raise ValueError("empty parameter list")
+        self.optimizer = optimizer
+        tree = {f"p{i}": from_torch(p.data) for i, p in
+                enumerate(self._params)}
+        self._jax_params = tree
+        self._state = optimizer.init(tree)
+
+    # -- reference-shaped API -------------------------------------------------
+
+    def zero_grad(self):
+        for p in self._params:
+            if p.grad is not None:
+                p.grad.detach_()
+                p.grad.zero_()
+
+    def step(self, grads: Optional[Iterable] = None, scale: float = 1.0,
+             lr=None):
+        """One fused step.  ``grads`` defaults to each param's ``.grad``
+        (torch autograd); ``scale`` divides grads (amp interop, matching the
+        deprecated contrib ``step(grads=, scale=)`` API)."""
+        torch = _torch()
+        if grads is None:
+            gs = []
+            for p in self._params:
+                if p.grad is None:
+                    raise RuntimeError("param has no .grad; run backward() "
+                                       "or pass grads= explicitly")
+                gs.append(p.grad)
+        else:
+            gs = list(grads)
+        gtree = {f"p{i}": from_torch(g) for i, g in enumerate(gs)}
+        # re-read the torch params every step: torch owns the weights (they
+        # may have been mutated by load_state_dict, clipping, EMA swaps...);
+        # the JAX side must never act on a stale snapshot.  For fused-impl
+        # optimizers the flat master in the state is re-seeded to match.
+        ptree = {f"p{i}": from_torch(p.data) for i, p in
+                 enumerate(self._params)}
+        if getattr(self._state, "master", None) is not None:
+            self._state = self._state._replace(
+                master=self.optimizer.flattener.flatten(ptree))
+        self._jax_params = ptree
+        new_params, self._state = self.optimizer.step(
+            self._state, gtree, self._jax_params, scale=scale, lr=lr)
+        self._jax_params = new_params
+        with torch.no_grad():
+            for i, p in enumerate(self._params):
+                p.data.copy_(to_torch(new_params[f"p{i}"]))
+        return None
+
+    # -- checkpointing --------------------------------------------------------
+
+    def state_dict(self):
+        return {"state": jax.device_get(self._state),
+                "params": jax.device_get(self._jax_params)}
+
+    def load_state_dict(self, d):
+        self._state = jax.tree_util.tree_map(jnp.asarray, d["state"])
+        self._jax_params = jax.tree_util.tree_map(jnp.asarray, d["params"])
+        torch = _torch()
+        with torch.no_grad():
+            for i, p in enumerate(self._params):
+                p.data.copy_(to_torch(self._jax_params[f"p{i}"]))
+
+
+__all__ = ["from_torch", "to_torch", "TorchFusedOptimizer"]

@@ -29,7 +29,8 @@ _NORM_PAT = re.compile(
     re.IGNORECASE)
 
 
-def is_norm_path(path) -> bool:
+def path_str(path) -> str:
+    """'/'-joined pytree key path (dict keys, attr names, sequence indices)."""
     keys = []
     for p in path:
         if hasattr(p, "key"):
@@ -40,7 +41,11 @@ def is_norm_path(path) -> bool:
             keys.append(str(p.idx))
         else:
             keys.append(str(p))
-    return bool(_NORM_PAT.search("/".join(keys)))
+    return "/".join(keys)
+
+
+def is_norm_path(path) -> bool:
+    return bool(_NORM_PAT.search(path_str(path)))
 
 
 def _is_float(x) -> bool:

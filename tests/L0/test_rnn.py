@@ -1,0 +1,122 @@
+"""RNN toolkit oracle tests vs torch.nn (the analog of the reference's
+tests/L0/run_amp/test_rnn.py casting checks, upgraded to full numeric
+parity — torch-layout weights drop into our cells leaf-for-leaf)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torch
+
+from apex_tpu.RNN import LSTM, GRU, Tanh, ReLU, mLSTM
+
+T, B, I, H = 5, 3, 8, 16
+
+
+def _copy_torch_weights(trnn, container, num_layers, bidirectional=False):
+    """torch RNN params -> our param pytree (same gate layout)."""
+    params = {}
+    dirs = 2 if bidirectional else 1
+    for layer in range(num_layers):
+        for d in range(dirs):
+            suffix = f"_l{layer}" + ("_reverse" if d else "")
+            name = f"layer{layer}" + ("_rev" if d else "")
+            p = {"w_ih": jnp.asarray(
+                     getattr(trnn, f"weight_ih{suffix}").detach().numpy()),
+                 "w_hh": jnp.asarray(
+                     getattr(trnn, f"weight_hh{suffix}").detach().numpy())}
+            if trnn.bias:
+                p["b_ih"] = jnp.asarray(
+                    getattr(trnn, f"bias_ih{suffix}").detach().numpy())
+                p["b_hh"] = jnp.asarray(
+                    getattr(trnn, f"bias_hh{suffix}").detach().numpy())
+            params[name] = p
+    return params
+
+
+@pytest.mark.parametrize("num_layers", [1, 2])
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_lstm_matches_torch(num_layers, bidirectional):
+    torch.manual_seed(0)
+    trnn = torch.nn.LSTM(I, H, num_layers, bidirectional=bidirectional)
+    ours = LSTM(I, H, num_layers, bidirectional=bidirectional)
+    params = _copy_torch_weights(trnn, ours, num_layers, bidirectional)
+
+    x = np.random.RandomState(0).randn(T, B, I).astype(np.float32)
+    tout, (thn, tcn) = trnn(torch.tensor(x))
+    out, finals = ours.apply(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               atol=1e-5)
+    # final hidden of the last layer, fwd direction
+    np.testing.assert_allclose(
+        np.asarray(finals[-2 if bidirectional else -1][0]),
+        thn[-2 if bidirectional else -1].detach().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("cell,tcls", [(GRU, torch.nn.GRU)])
+def test_gru_matches_torch(cell, tcls):
+    torch.manual_seed(1)
+    trnn = tcls(I, H, 2)
+    ours = cell(I, H, 2)
+    params = _copy_torch_weights(trnn, ours, 2)
+    x = np.random.RandomState(1).randn(T, B, I).astype(np.float32)
+    tout, _ = trnn(torch.tensor(x))
+    out, _ = ours.apply(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("ours_fn,nonlin", [(Tanh, "tanh"), (ReLU, "relu")])
+def test_elman_matches_torch(ours_fn, nonlin):
+    torch.manual_seed(2)
+    trnn = torch.nn.RNN(I, H, 1, nonlinearity=nonlin)
+    ours = ours_fn(I, H, 1)
+    params = _copy_torch_weights(trnn, ours, 1)
+    x = np.random.RandomState(2).randn(T, B, I).astype(np.float32)
+    tout, _ = trnn(torch.tensor(x))
+    out, _ = ours.apply(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               atol=1e-5)
+
+
+def test_mlstm_shapes_and_grad():
+    """mLSTM has no torch oracle; check the multiplicative structure trains
+    and jits (reference cells.py:55-83)."""
+    ours = mLSTM(I, H, 1)
+    params = ours.init(jax.random.PRNGKey(0))
+    assert "w_mih" in params["layer0"] and "w_mhh" in params["layer0"]
+    x = jnp.ones((T, B, I))
+
+    @jax.jit
+    def loss(params):
+        out, _ = ours.apply(params, x)
+        return jnp.mean(out ** 2)
+
+    g = jax.grad(loss)(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert float(jnp.abs(g["layer0"]["w_mih"]).sum()) > 0
+
+
+def test_batch_first_and_output_size_and_dropout():
+    ours = LSTM(I, H, 2, batch_first=True, dropout=0.5, output_size=12)
+    params = ours.init(jax.random.PRNGKey(1))
+    assert params["layer0"]["w_ho"].shape == (12, H)
+    x = jnp.ones((B, T, I))
+    out, _ = ours.apply(params, x, rng=jax.random.PRNGKey(2))
+    assert out.shape == (B, T, 12)
+    # dropout off without rng (eval mode): deterministic
+    o1, _ = ours.apply(params, x)
+    o2, _ = ours.apply(params, x)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+
+
+def test_initial_hidden_passthrough():
+    ours = GRU(I, H, 1)
+    params = ours.init(jax.random.PRNGKey(3))
+    x = jnp.zeros((T, B, I))
+    h0 = (jnp.ones((B, H)),)
+    out0, _ = ours.apply(params, x)
+    out1, _ = ours.apply(params, x, hx=[h0])
+    assert not np.allclose(np.asarray(out0[0]), np.asarray(out1[0]))

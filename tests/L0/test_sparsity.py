@@ -1,0 +1,147 @@
+"""ASP 2:4 sparsity tests — mirrors the reference's toy-problem and 3-part
+checkpoint-continuity scripts (apex/contrib/sparsity/test/)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity import ASP, create_mask, mn_1d_best
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu import checkpoint
+
+
+def brute_force_best_mask_row(row):
+    """Oracle: per group of 4, keep the 2 largest |values|."""
+    out = np.zeros_like(row)
+    for g in range(0, len(row), 4):
+        grp = np.abs(row[g:g + 4])
+        keep = np.argsort(-grp)[:2]
+        for k in keep:
+            out[g + k] = 1.0
+    return out
+
+
+def test_mn_1d_best_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    mat = rng.randn(6, 16).astype(np.float32)
+    mask = np.asarray(mn_1d_best(jnp.asarray(mat), 4, 2))
+    for i in range(mat.shape[0]):
+        np.testing.assert_array_equal(mask[i],
+                                      brute_force_best_mask_row(mat[i]))
+
+
+def test_mask_density_and_axis():
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    m_last = create_mask(w, axis=-1)
+    assert float(m_last.mean()) == 0.5
+    # every aligned group of 4 along the masked axis has exactly 2 kept
+    g = np.asarray(m_last).reshape(8, 4, 4).sum(axis=2)
+    assert (g == 2).all()
+    m_contract = create_mask(w, axis=-2)       # default ASP axis
+    gc = np.asarray(m_contract).reshape(2, 4, 16).sum(axis=1)
+    assert (gc == 2).all()
+
+
+def test_create_mask_ragged_pads_prefer_masking():
+    w = jnp.asarray(np.arange(1, 7, dtype=np.float32).reshape(1, 6))
+    m = np.asarray(create_mask(w, axis=-1))
+    # group 2 is ragged (2 real + 2 pad): both real elements kept
+    assert m[0, 4] == 1 and m[0, 5] == 1
+    assert m.sum() == 4  # 2 + 2
+
+
+def _toy_params(seed=0):
+    k = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return {
+        "fc1": {"w": jax.random.normal(k[0], (16, 32)),
+                "b": jnp.zeros((32,))},
+        "fc2": {"w": jax.random.normal(k[1], (32, 8)),
+                "b": jnp.zeros((8,))},
+        "tiny": jax.random.normal(k[2], (3, 5)),   # ineligible (divisibility)
+    }
+
+
+def test_eligibility_rules():
+    asp = ASP(verbosity=0).init_model_for_pruning(_toy_params())
+    elig = asp._eligible_paths
+    assert "fc1/w" in elig and "fc2/w" in elig
+    assert "fc1/b" not in elig          # ndim < 2
+    assert "tiny" not in elig           # 5 % 8 != 0, 3 % 4 != 0
+    asp2 = ASP(disallowed_layer_names=("fc2",)).init_model_for_pruning(
+        _toy_params())
+    assert "fc2/w" not in asp2._eligible_paths
+    asp3 = ASP(allowed_layer_names=("fc2",)).init_model_for_pruning(
+        _toy_params())
+    assert asp3._eligible_paths == frozenset({"fc2/w"})
+
+
+def test_requires_init_ordering():
+    asp = ASP()
+    with pytest.raises(RuntimeError):
+        asp.compute_sparse_masks(_toy_params())
+
+
+def sparsity_ok(p, masks):
+    """Eligible leaves 2:4 along axis -2; ineligible untouched (mask==1)."""
+    w = np.asarray(p["fc1"]["w"])
+    groups = w.reshape(4, 4, 32)
+    nz = (groups != 0).sum(axis=1)
+    return (nz <= 2).all()
+
+
+def test_wrapped_optimizer_keeps_sparsity():
+    params = _toy_params()
+    asp = ASP().init_model_for_pruning(params)
+    masks = asp.compute_sparse_masks(params)
+    params = asp.prune(params, masks)
+    opt = asp.wrap_optimizer(FusedAdam(lr=1e-2, weight_decay=0.01), masks)
+    state = opt.init(params)
+    step = jax.jit(lambda s, g, p: opt.step(s, g, p))
+    for i in range(4):
+        grads = jax.tree_util.tree_map(
+            lambda x: 0.1 * jnp.ones_like(x) * (i + 1), params)
+        params, state = step(state, grads, params)
+    assert sparsity_ok(params, masks)
+    # the bias (ineligible) did train
+    assert float(jnp.abs(params["fc1"]["b"]).sum()) > 0
+
+
+def test_checkpoint_continuity():
+    """Part-1 train -> save; part-2 load -> recompute masks -> masks equal
+    and training continues sparse (the reference's checkpointing_test_part1/
+    2 flow)."""
+    params = _toy_params()
+    asp = ASP().init_model_for_pruning(params)
+    masks = asp.compute_sparse_masks(params)
+    params = asp.prune(params, masks)
+    opt = asp.wrap_optimizer(FusedAdam(lr=1e-2), masks)
+    state = opt.init(params)
+    for i in range(2):
+        grads = jax.tree_util.tree_map(lambda x: 0.1 * jnp.ones_like(x),
+                                       params)
+        params, state = opt.step(state, grads, params)
+    checkpoint.save("/tmp/asp_ckpt.pkl", params=params)
+
+    # "part 2": fresh process state
+    loaded = checkpoint.load("/tmp/asp_ckpt.pkl")["params"]
+    loaded = checkpoint.restore_like(params, loaded)
+    asp2 = ASP().init_model_for_pruning(loaded)
+    masks2 = asp2.compute_sparse_masks(loaded)
+    # a pruned weight's mask recomputes to itself
+    for a, b in zip(jax.tree_util.tree_leaves(masks),
+                    jax.tree_util.tree_leaves(masks2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    opt2 = asp2.wrap_optimizer(FusedAdam(lr=1e-2), masks2)
+    st2 = opt2.init(loaded)
+    p2, _ = opt2.step(st2, jax.tree_util.tree_map(
+        lambda x: 0.1 * jnp.ones_like(x), loaded), loaded)
+    assert sparsity_ok(p2, masks2)
+
+
+def test_masks_jit_and_grad_safe():
+    params = _toy_params()
+    asp = ASP().init_model_for_pruning(params)
+    masks = jax.jit(asp.compute_sparse_masks)(params)
+    assert float(masks["fc1"]["w"].mean()) == 0.5
