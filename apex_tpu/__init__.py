@@ -26,5 +26,6 @@ from . import contrib
 from . import pyprof
 from . import interop
 from . import RNN
+from . import reparameterization
 
 __version__ = "0.1.0"

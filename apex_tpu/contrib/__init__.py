@@ -9,5 +9,6 @@ from . import xentropy
 from . import multihead_attn
 from . import optimizers
 from . import sparsity
+from . import groupbn
 
-__all__ = ["xentropy", "multihead_attn", "optimizers", "sparsity"]
+__all__ = ["xentropy", "multihead_attn", "optimizers", "sparsity", "groupbn"]

@@ -1,0 +1,6 @@
+"""groupbn — NHWC BatchNorm with fused add+ReLU and group-scoped stats
+(reference: ``apex/contrib/groupbn/batch_norm.py:101`` ``BatchNorm2d_NHWC``).
+"""
+from .batch_norm import BatchNorm2d_NHWC, bn_nhwc, bn_add_relu_nhwc
+
+__all__ = ["BatchNorm2d_NHWC", "bn_nhwc", "bn_add_relu_nhwc"]

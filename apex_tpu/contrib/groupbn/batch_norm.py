@@ -1,0 +1,91 @@
+"""BatchNorm2d_NHWC — module-API parity for the reference's groupbn.
+
+The reference's ``bnp`` extension is ~5k LoC of persistent NHWC batch-norm
+CUDA kernels with cross-GPU IPC peer buffers for ``bn_group``
+(``apex/contrib/csrc/groupbn/``: ``batch_norm.cu``, ``batch_norm_add_relu.cu``,
+``nhwc_batch_norm_kernel.h``, ``ipc.cu``).  On TPU every piece of that
+machinery maps onto things the stack already does well:
+
+- NHWC is the native layout (no transpose kernels needed);
+- the BN math fuses into neighbors under XLA (the persistent-kernel win);
+- cross-device stats ride ``lax.psum`` over a mesh (sub-)axis — ``bn_group``
+  becomes a group-scoped mesh axis (``create_grouped_mesh``), replacing
+  the CUDA-IPC ``my_data/pair_data`` peer exchange entirely;
+- occupancy knobs (``max_cta_per_sm``, ``cta_launch_margin``,
+  ``multi_stream``) have no meaning: XLA owns scheduling.  They are
+  accepted and ignored for API compatibility, like the DDP no-op knobs.
+
+So this module is the *module API* over ``parallel.sync_batch_norm`` with
+the groupbn surface: ``fuse_relu``, the fused residual ``add`` input
+(``batch_norm_add_relu.cu``), and ``bn_group``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ...parallel.sync_batchnorm import sync_batch_norm
+from ...parallel.mesh import GROUP_AXIS
+
+
+def bn_nhwc(x, scale, bias, mean, var, *, axis_name=None, training=True,
+            momentum=0.1, eps=1e-5, fuse_relu=False):
+    """Functional NHWC BN (``bn_NHWC_impl``, batch_norm.py:7)."""
+    return sync_batch_norm(x, scale, bias, mean, var, axis_name=axis_name,
+                           training=training, momentum=momentum, eps=eps,
+                           channel_last=True, fuse_relu=fuse_relu)
+
+
+def bn_add_relu_nhwc(x, z, scale, bias, mean, var, *, axis_name=None,
+                     training=True, momentum=0.1, eps=1e-5):
+    """Fused BN + residual-add + ReLU (``bn_addrelu_NHWC_impl``)."""
+    return sync_batch_norm(x, scale, bias, mean, var, axis_name=axis_name,
+                           training=training, momentum=momentum, eps=eps,
+                           channel_last=True, fuse_relu=True, z=z)
+
+
+class BatchNorm2d_NHWC:
+    """Module mirror of ``BatchNorm2d_NHWC`` (batch_norm.py:101).
+
+    ``bn_group > 1`` scopes the statistics to the ``group`` mesh axis (use
+    ``parallel.create_grouped_mesh(group_size)``); 1 = per-device stats
+    unless the call site binds axes explicitly via ``axis_name``.
+    Occupancy/stream knobs are accepted no-ops (see module docstring).
+    """
+
+    def __init__(self, num_features: int, fuse_relu: bool = False,
+                 bn_group: int = 1, max_cta_per_sm: int = 2,
+                 cta_launch_margin: int = 12, multi_stream: bool = False,
+                 momentum: float = 0.1, eps: float = 1e-5):
+        del max_cta_per_sm, cta_launch_margin, multi_stream  # no-op knobs
+        self.num_features = num_features
+        self.fuse_relu = fuse_relu
+        self.bn_group = bn_group
+        self.momentum = momentum
+        self.eps = eps
+
+    def init(self):
+        """Returns (params, state): scale/bias + running stats."""
+        c = self.num_features
+        params = {"scale": jnp.ones((c,), jnp.float32),
+                  "bn_bias": jnp.zeros((c,), jnp.float32)}
+        state = {"mean": jnp.zeros((c,), jnp.float32),
+                 "var": jnp.ones((c,), jnp.float32)}
+        return params, state
+
+    def apply(self, params, state, x, z=None, *, training=True,
+              axis_name=None):
+        """x (N, H, W, C); optional residual ``z`` (add before ReLU).
+        Returns (out, new_state)."""
+        if axis_name is None and self.bn_group > 1:
+            axis_name = GROUP_AXIS
+        out, mean, var = sync_batch_norm(
+            x, params["scale"], params["bn_bias"], state["mean"],
+            state["var"], axis_name=axis_name, training=training,
+            momentum=self.momentum, eps=self.eps, channel_last=True,
+            fuse_relu=self.fuse_relu or z is not None, z=z)
+        new_state = {"mean": mean, "var": var} if training else state
+        return out, new_state
+
+    __call__ = apply

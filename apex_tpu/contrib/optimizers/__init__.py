@@ -1,8 +1,10 @@
-"""ZeRO-style sharded data-parallel optimizers (reference:
+"""contrib optimizers: ZeRO-style sharded data-parallel optimizers and the
+flat fused FP16_Optimizer (reference:
 ``apex/contrib/optimizers/distributed_fused_adam.py``,
-``distributed_fused_lamb.py``)."""
+``distributed_fused_lamb.py``, ``fp16_optimizer.py``)."""
 from .distributed_fused import (DistributedFusedAdam, DistributedFusedLAMB,
                                 ShardedAdamState, ShardedLAMBState)
+from .fp16_optimizer import FP16_Optimizer
 
 __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
-           "ShardedAdamState", "ShardedLAMBState"]
+           "ShardedAdamState", "ShardedLAMBState", "FP16_Optimizer"]

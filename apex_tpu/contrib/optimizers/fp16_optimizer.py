@@ -1,0 +1,96 @@
+"""contrib FP16_Optimizer — the cut-down master-weight wrapper for FUSED
+optimizers only (reference ``apex/contrib/optimizers/fp16_optimizer.py:4``).
+
+Where the legacy ``apex_tpu.fp16_utils.FP16_Optimizer`` keeps per-leaf fp32
+masters, the contrib version is the FLAT variant: one contiguous fp32
+master buffer, fused unscale-with-overflow-check on the flat gradients
+(the reference's ``multi_tensor_scale`` into ``_overflow_buf``,
+``fp16_optimizer.py:94-130``), and the fused update running entirely on
+flat state.  On TPU that is exactly the flat engine the fused optimizers
+already carry (impl='fused': master + moments permanently flat), so this
+wrapper is a thin stateful facade over ``step_flat``:
+
+    opt = FP16_Optimizer(FusedAdam(lr=..., impl="fused"), model_params,
+                         dynamic_loss_scale=True)
+    scaled = opt.scale_loss(loss)            # ... take grads of scaled ...
+    model_params = opt.step(scaled_grads)    # flat unscale+check+update
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...amp import scaler as _scaler
+from ...multi_tensor_apply import kernels
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, model_params, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=False):
+        if init_optimizer.impl != "fused":
+            raise ValueError(
+                "contrib FP16_Optimizer wraps FUSED optimizers only "
+                "(reference fp16_optimizer.py:4); pass impl='fused' or use "
+                "apex_tpu.fp16_utils.FP16_Optimizer for the per-leaf path")
+        self.optimizer = init_optimizer
+        # flat fp32 master + moments live inside the fused state
+        self.opt_state = init_optimizer.init(model_params)
+        self._model_dtypes = jax.tree_util.tree_map(
+            lambda p: p.dtype, model_params)
+        args = dynamic_loss_args or {}
+        if dynamic_loss_scale:
+            self.scaler_state = _scaler.init(
+                "dynamic", init_scale=args.get("init_scale", 2.0 ** 16),
+                scale_window=args.get("scale_window", 2000))
+        else:
+            self.scaler_state = _scaler.init(static_loss_scale)
+        self.overflow = False
+
+    @property
+    def loss_scale(self):
+        return float(self.scaler_state.loss_scale)
+
+    def scale_loss(self, loss):
+        return _scaler.scale_loss(self.scaler_state, loss)
+
+    def step(self, scaled_grads):
+        """Flat pipeline: pack grads -> fused unscale + overflow flag
+        (multi_tensor_scale, fp16_optimizer.py:101-113) -> fused update on
+        the flat master -> skip-select on overflow -> model copies."""
+        fl = self.optimizer.flattener
+        flat_scaled = fl.flatten(scaled_grads)
+        inv = 1.0 / self.scaler_state.loss_scale
+        flat_g32, of_flag = kernels.multi_tensor_scale(flat_scaled, inv)
+        finite = (of_flag == 0)
+
+        new_state = self.optimizer.step_flat(self.opt_state, flat_g32)
+        new_state = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(finite, n, o), new_state, self.opt_state)
+        self.scaler_state = _scaler.update(self.scaler_state, finite)
+        self.opt_state = new_state
+        self.overflow = not bool(finite)
+        return self.model_params()
+
+    def model_params(self):
+        """Current model-precision params from the flat master."""
+        return jax.tree_util.tree_map(
+            lambda p, dt: p.astype(dt),
+            self.optimizer.model_params(self.opt_state),
+            self._model_dtypes)
+
+    def clip_master_grads(self, grads, max_norm):
+        from ...optimizers._base import global_l2norm
+        norm = global_l2norm(grads)
+        coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+        return jax.tree_util.tree_map(lambda g: g * coef, grads), norm
+
+    def state_dict(self):
+        return {"loss_scaler": _scaler.state_dict(self.scaler_state),
+                "overflow": self.overflow,
+                "opt_state": self.opt_state}
+
+    def load_state_dict(self, d):
+        self.scaler_state = _scaler.load_state_dict(d["loss_scaler"])
+        self.overflow = d["overflow"]
+        self.opt_state = d["opt_state"]

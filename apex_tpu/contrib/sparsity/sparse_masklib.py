@@ -66,6 +66,10 @@ def create_mask(tensor: jnp.ndarray, pattern: str = "m4n2_1d",
         if pattern not in _PATTERNS:
             raise ValueError(f"unknown sparsity pattern {pattern!r}; "
                              f"have {sorted(_PATTERNS)}")
+        if density != 0.5:
+            raise ValueError(
+                f"pattern {pattern!r} has fixed density 0.5 (n/m); "
+                f"got density={density}")
         fn = _PATTERNS[pattern]
     else:
         fn = pattern

@@ -108,13 +108,18 @@ class TorchFusedOptimizer:
                 gs.append(p.grad)
         else:
             gs = list(grads)
-        gtree = {f"p{i}": from_torch(g) for i, g in enumerate(gs)}
+        # COPY on import (not zero-copy): the torch side keeps mutating
+        # these buffers (zero_grad, in-place ops) while async-dispatched JAX
+        # computations may still be reading them — an alias here silently
+        # corrupts the optimizer moments.
+        gtree = {f"p{i}": jnp.array(from_torch(g), copy=True)
+                 for i, g in enumerate(gs)}
         # re-read the torch params every step: torch owns the weights (they
         # may have been mutated by load_state_dict, clipping, EMA swaps...);
         # the JAX side must never act on a stale snapshot.  For fused-impl
         # optimizers the flat master in the state is re-seeded to match.
-        ptree = {f"p{i}": from_torch(p.data) for i, p in
-                 enumerate(self._params)}
+        ptree = {f"p{i}": jnp.array(from_torch(p.data), copy=True)
+                 for i, p in enumerate(self._params)}
         if getattr(self._state, "master", None) is not None:
             self._state = self._state._replace(
                 master=self.optimizer.flattener.flatten(ptree))

@@ -34,6 +34,16 @@ def _mlp_forward(x, weights, biases, activation="relu"):
 mlp_function = _amp.half_function(_mlp_forward)
 
 
+def _mlp_pallas_fwd(x, weights, biases, activation):
+    from ..ops.fused_mlp import mlp_pallas
+    return mlp_pallas(x, weights, biases, activation)
+
+
+# the pallas path goes through the SAME amp autocast wrapper so both impls
+# see identical precision under amp (O1/O4 patched-function casting)
+_mlp_pallas_function = _amp.half_function(_mlp_pallas_fwd)
+
+
 class MLP:
     """``apex.mlp.MLP`` analog (mlp.py:26-79): sizes = [in, h1, ..., out].
 
@@ -42,7 +52,7 @@ class MLP:
     """
 
     def __init__(self, mlp_sizes: Sequence[int], bias=True, relu=True,
-                 activation=None):
+                 activation=None, use_pallas=False):
         if activation is None:
             activation = "relu" if relu else "none"
         if activation not in ("none", "relu", "sigmoid"):
@@ -50,6 +60,9 @@ class MLP:
         self.sizes = list(mlp_sizes)
         self.bias = bias
         self.activation = activation
+        # Pallas fused GEMM+epilogue per layer (ops/fused_mlp.py) — the
+        # mlp_cuda perf-ceiling analog (SURVEY §2.2)
+        self.use_pallas = use_pallas
 
     def init(self, rng):
         """Matches the reference's reset_parameters (mlp.py:64-72):
@@ -73,6 +86,9 @@ class MLP:
         return params
 
     def apply(self, params, x):
+        if self.use_pallas:
+            return _mlp_pallas_function(x, params["weights"],
+                                        params["biases"], self.activation)
         return mlp_function(x, params["weights"], params["biases"],
                             self.activation)
 

@@ -7,5 +7,7 @@ blocking structure that benefits from explicit kernels: layer norm (the
 softmax-xentropy living in ``apex_tpu.contrib``.
 """
 from .layer_norm import layer_norm_pallas, pallas_available
+from .fused_mlp import dense_act, fused_dense_act, mlp_pallas
 
-__all__ = ["layer_norm_pallas", "pallas_available"]
+__all__ = ["layer_norm_pallas", "pallas_available", "dense_act",
+           "fused_dense_act", "mlp_pallas"]

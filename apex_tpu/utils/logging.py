@@ -1,0 +1,86 @@
+"""Rank-0-gated logging + meters (SURVEY §5.5).
+
+The reference's observability is print-based with rank-0 gating and
+one-time warning latches (``apex/amp/_amp_state.py:38-50`` ``maybe_print``,
+``scaler.py:43-45`` warned latches) plus the examples' ``AverageMeter`` with
+its "printing costs an allreduce+sync" batching note
+(``examples/imagenet/main_amp.py:363-390``).  Same scope here, as a small
+shared util instead of per-module copies.
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional
+
+import jax
+
+_warned: set = set()
+
+
+def rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:  # pragma: no cover - pre-init edge
+        return 0
+
+
+def is_rank0() -> bool:
+    return rank() == 0
+
+
+def maybe_print(msg: str, *, rank0_only: bool = True, file=None) -> None:
+    """``_amp_state.maybe_print`` analog: print unless gated off-rank."""
+    if not rank0_only or is_rank0():
+        print(msg, file=file or sys.stdout, flush=True)
+
+
+def warn_once(key: str, msg: Optional[str] = None) -> bool:
+    """One-time warning latch (scaler.py:43-45).  Returns True the first
+    time ``key`` is seen (and prints ``msg`` if given, rank-0 only)."""
+    if key in _warned:
+        return False
+    _warned.add(key)
+    if msg is not None:
+        maybe_print(msg, file=sys.stderr)
+    return True
+
+
+class AverageMeter:
+    """Running value/average (examples/imagenet/main_amp.py AverageMeter)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.val = self.sum = self.count = 0.0
+
+    def update(self, val, n=1):
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+
+    @property
+    def avg(self):
+        return self.sum / max(self.count, 1)
+
+    def __str__(self):
+        return f"{self.name} {self.val:.4f} ({self.avg:.4f})"
+
+
+class Throughput:
+    """items/sec between ``tick()`` calls — the Speed print helper.  The
+    host sync needed for honest timing is the CALLER's float() readback
+    (the reference's 'printing costs a sync' note applies unchanged)."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.meter = AverageMeter("items/s")
+
+    def tick(self, n_items: int) -> float:
+        now = time.perf_counter()
+        rate = n_items / max(now - self.t0, 1e-9)
+        self.meter.update(rate)
+        self.t0 = now
+        return rate

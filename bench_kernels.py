@@ -176,6 +176,32 @@ def bench_layer_norm(results, on_tpu):
     results["layer_norm_fwdbwd"]["shape"] = f"N{N} H{H}"
 
 
+def bench_mlp(results, on_tpu):
+    from apex_tpu.mlp import MLP
+
+    sizes, batch = ([1024, 4096, 4096, 1024], 8192) if on_tpu else \
+        ([64, 128, 64], 128)
+    x = jax.random.normal(jax.random.PRNGKey(4), (batch, sizes[0]),
+                          jnp.bfloat16)
+    mlp_x = MLP(sizes, activation="relu")
+    mlp_p = MLP(sizes, activation="relu", use_pallas=True)
+    params = mlp_x.init(jax.random.PRNGKey(5))
+
+    results["mlp_fwd"] = ab(
+        "mlp_fwd", jax.jit(lambda x: mlp_p.apply(params, x)),
+        jax.jit(lambda x: mlp_x.apply(params, x)), x)
+    results["mlp_fwd"]["shape"] = f"B{batch} {sizes}"
+
+    def fb(m):
+        def f(x):
+            return jax.grad(lambda x_: jnp.sum(
+                m.apply(params, x_).astype(jnp.float32)))(x)
+        return f
+
+    results["mlp_fwdbwd"] = ab(
+        "mlp_fwdbwd", jax.jit(fb(mlp_p)), jax.jit(fb(mlp_x)), x)
+
+
 def bench_multi_tensor(results, on_tpu):
     from apex_tpu.multi_tensor_apply import (multi_tensor_l2norm,
                                              multi_tensor_scale,
@@ -210,7 +236,7 @@ def run(budget_left=lambda: 1e9):
             'meaningful'})")
     results = {}
     for fn in (bench_attention, bench_xentropy, bench_layer_norm,
-               bench_multi_tensor):
+               bench_mlp, bench_multi_tensor):
         if budget_left() < 40:
             _log(f"budget exhausted before {fn.__name__}")
             break

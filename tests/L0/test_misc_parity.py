@@ -1,0 +1,194 @@
+"""Tests for the smaller parity components: groupbn BatchNorm2d_NHWC,
+weight-norm reparameterization, rank-0 logging utils, and the multiproc
+launcher (the reference's launcher had zero tests; SURVEY weak #6)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torch
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.reparameterization import (apply_weight_norm, compute_weights,
+                                         remove_weight_norm, compute_weight,
+                                         init_weight_norm)
+from apex_tpu.utils.logging import (AverageMeter, Throughput, maybe_print,
+                                    warn_once, is_rank0)
+
+
+# -- groupbn ----------------------------------------------------------------
+
+def test_bn_nhwc_matches_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6, 6, 8).astype(np.float32)
+    bn = BatchNorm2d_NHWC(8)
+    params, state = bn.init()
+    out, new_state = bn.apply(params, state, jnp.asarray(x))
+
+    tbn = torch.nn.BatchNorm2d(8)
+    ref = tbn(torch.tensor(x).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["mean"]),
+                               tbn.running_mean.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["var"]),
+                               tbn.running_var.numpy(), atol=1e-4)
+
+
+def test_bn_nhwc_fused_add_relu_and_eval():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 4, 4, 8).astype(np.float32))
+    z = jnp.asarray(rng.randn(2, 4, 4, 8).astype(np.float32))
+    bn = BatchNorm2d_NHWC(8, fuse_relu=True)
+    params, state = bn.init()
+    out, state2 = bn.apply(params, state, x, z=z)
+    assert float(jnp.min(out)) >= 0.0          # relu applied
+    # eval mode: state unchanged, uses running stats
+    out_eval, state3 = bn.apply(params, state2, x, training=False)
+    assert state3 is state2
+    # occupancy knobs are accepted no-ops
+    BatchNorm2d_NHWC(8, max_cta_per_sm=4, cta_launch_margin=3,
+                     multi_stream=True)
+
+
+# -- weight norm ------------------------------------------------------------
+
+def test_weight_norm_matches_torch():
+    torch.manual_seed(0)
+    lin = torch.nn.Linear(6, 10, bias=False)
+    wn = torch.nn.utils.weight_norm(lin, dim=0)
+    w = wn.weight_v.detach().numpy()           # (out=10, in=6)
+    g = wn.weight_g.detach().numpy()
+
+    ours = compute_weight(jnp.asarray(g), jnp.asarray(w), dim=0)
+    np.testing.assert_allclose(np.asarray(ours),
+                               wn.weight.detach().numpy(), atol=1e-6)
+
+
+def test_apply_remove_round_trip_and_grads():
+    params = {"fc": {"w": jnp.asarray(
+        np.random.RandomState(2).randn(8, 4).astype(np.float32)),
+        "b": jnp.zeros((4,))}}
+    wn_params, spec = apply_weight_norm(params, names=("w",), dim=0)
+    assert "fc/w" in spec
+    assert set(wn_params["fc"]["w"].keys()) == {"weight_g", "weight_v"}
+    # exact reconstruction
+    back = remove_weight_norm(wn_params, spec)
+    np.testing.assert_allclose(np.asarray(back["fc"]["w"]),
+                               np.asarray(params["fc"]["w"]), atol=1e-6)
+    # bias untouched
+    np.testing.assert_array_equal(np.asarray(back["fc"]["b"]),
+                                  np.asarray(params["fc"]["b"]))
+
+    # grads flow to g and v
+    def loss(p):
+        full = compute_weights(p, spec)
+        return jnp.sum(full["fc"]["w"] ** 2)
+
+    g = jax.grad(loss)(wn_params)
+    assert float(jnp.abs(g["fc"]["w"]["weight_g"]).sum()) > 0
+    assert float(jnp.abs(g["fc"]["w"]["weight_v"]).sum()) > 0
+
+
+def test_weight_norm_dim_none():
+    w = jnp.asarray(np.random.RandomState(3).randn(5, 4).astype(np.float32))
+    gv = init_weight_norm(w, dim=None)
+    assert gv["weight_g"].shape == ()
+    np.testing.assert_allclose(np.asarray(
+        compute_weight(gv["weight_g"], gv["weight_v"], None)),
+        np.asarray(w), atol=1e-6)
+
+
+# -- logging ----------------------------------------------------------------
+
+def test_logging_utils(capsys):
+    assert is_rank0()
+    maybe_print("hello")
+    assert "hello" in capsys.readouterr().out
+    assert warn_once("k1", "warned")
+    assert not warn_once("k1", "warned")       # latched
+    m = AverageMeter("loss")
+    m.update(2.0)
+    m.update(4.0)
+    assert m.avg == 3.0 and "loss" in str(m)
+    t = Throughput()
+    assert t.tick(10) > 0
+
+
+# -- launcher ---------------------------------------------------------------
+
+def test_multiproc_launcher_runs_script(tmp_path):
+    """python -m apex_tpu.parallel.multiproc script.py — single-node exec
+    with clean cluster env (the reference's launcher was never tested)."""
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os, sys\n"
+        "assert 'APEX_TPU_COORDINATOR_ADDRESS' not in os.environ\n"
+        "print('LAUNCHED', sys.argv[1])\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo",
+               APEX_TPU_COORDINATOR_ADDRESS="stale:1234")
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.multiproc",
+         str(script), "argA"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "LAUNCHED argA" in r.stdout
+
+
+def test_multiproc_launcher_multinode_env(tmp_path):
+    script = tmp_path / "probe2.py"
+    script.write_text(
+        "import os\n"
+        "print('ENV', os.environ['APEX_TPU_COORDINATOR_ADDRESS'],\n"
+        "      os.environ['APEX_TPU_NUM_PROCESSES'],\n"
+        "      os.environ['APEX_TPU_PROCESS_ID'])\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.multiproc",
+         "--nnodes", "2", "--node_rank", "1",
+         "--coordinator", "host0:9999", str(script)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "ENV host0:9999 2 1" in r.stdout
+
+
+# -- contrib FP16_Optimizer (flat fused wrapper) -----------------------------
+
+def test_contrib_fp16_optimizer_flat():
+    from apex_tpu.contrib.optimizers import FP16_Optimizer as CFP16
+    from apex_tpu.optimizers import FusedAdam
+
+    params = {"w": jnp.asarray(np.random.RandomState(5)
+                               .randn(16, 8).astype(np.float32))}
+    with pytest.raises(ValueError):
+        CFP16(FusedAdam(lr=1e-2, impl="xla"), params)
+
+    opt = CFP16(FusedAdam(lr=1e-2, impl="fused"), params,
+                dynamic_loss_scale=True)
+    scale = opt.loss_scale
+    g = {"w": jnp.full((16, 8), 0.1) * scale}
+    p1 = opt.step(g)
+    assert not opt.overflow
+    # oracle: plain fused adam on unscaled grads
+    ref_opt = FusedAdam(lr=1e-2, impl="fused")
+    st = ref_opt.init(params)
+    pref, _ = ref_opt.step(st, {"w": jnp.full((16, 8), 0.1)}, params)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(pref["w"]),
+                               atol=1e-6)
+
+    # overflow: step skipped, scale halved
+    bad = {"w": jnp.full((16, 8), np.inf)}
+    p2 = opt.step(bad)
+    assert opt.overflow and opt.loss_scale == scale / 2
+    np.testing.assert_array_equal(np.asarray(p2["w"]), np.asarray(p1["w"]))
+
+    # state_dict round trip
+    sd = opt.state_dict()
+    opt2 = CFP16(FusedAdam(lr=1e-2, impl="fused"), params)
+    opt2.load_state_dict(sd)
+    assert opt2.loss_scale == opt.loss_scale
