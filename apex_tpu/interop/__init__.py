@@ -80,8 +80,10 @@ class TorchFusedOptimizer:
         if not self._params:
             raise ValueError("empty parameter list")
         self.optimizer = optimizer
-        tree = {f"p{i}": from_torch(p.data) for i, p in
-                enumerate(self._params)}
+        # LIST pytree: flatten order == param order (a dict of "p{i}" keys
+        # would sort lexicographically and scramble >=10 params; a tuple
+        # would collide with the optimizers' tuple-as-leaf convention)
+        tree = [from_torch(p.data) for p in self._params]
         self._jax_params = tree
         self._state = optimizer.init(tree)
 
@@ -108,18 +110,19 @@ class TorchFusedOptimizer:
                 gs.append(p.grad)
         else:
             gs = list(grads)
+        if self._native_fast_path_ok(gs):
+            return self._step_packed(gs, scale, lr)
         # COPY on import (not zero-copy): the torch side keeps mutating
         # these buffers (zero_grad, in-place ops) while async-dispatched JAX
         # computations may still be reading them — an alias here silently
         # corrupts the optimizer moments.
-        gtree = {f"p{i}": jnp.array(from_torch(g), copy=True)
-                 for i, g in enumerate(gs)}
+        gtree = [jnp.array(from_torch(g), copy=True) for g in gs]
         # re-read the torch params every step: torch owns the weights (they
         # may have been mutated by load_state_dict, clipping, EMA swaps...);
         # the JAX side must never act on a stale snapshot.  For fused-impl
         # optimizers the flat master in the state is re-seeded to match.
-        ptree = {f"p{i}": jnp.array(from_torch(p.data), copy=True)
-                 for i, p in enumerate(self._params)}
+        ptree = [jnp.array(from_torch(p.data), copy=True)
+                 for p in self._params]
         if getattr(self._state, "master", None) is not None:
             self._state = self._state._replace(
                 master=self.optimizer.flattener.flatten(ptree))
@@ -128,23 +131,67 @@ class TorchFusedOptimizer:
             self._state, gtree, self._jax_params, scale=scale, lr=lr)
         self._jax_params = new_params
         with torch.no_grad():
-            for i, p in enumerate(self._params):
-                p.data.copy_(to_torch(new_params[f"p{i}"]))
+            for p, new in zip(self._params, new_params):
+                p.data.copy_(to_torch(new))
+        return None
+
+    # -- native packed fast path ---------------------------------------------
+
+    def _native_fast_path_ok(self, gs) -> bool:
+        """The C++ staging-buffer path (utils.host_pack, the apex_C analog):
+        flat fused state + CPU fp32 torch tensors on both sides."""
+        torch = _torch()
+        if getattr(self._state, "master", None) is None:
+            return False
+        return all(
+            t.device.type == "cpu" and t.dtype == torch.float32
+            and t.is_contiguous()
+            for t in list(self._params) + list(gs))
+
+    def _step_packed(self, gs, scale, lr):
+        """One host pack (threaded C++ memcpy) -> ONE transfer -> step_flat
+        -> ONE transfer -> one host unpack into the torch storages."""
+        from ..utils import host_pack
+        torch = _torch()
+        fl = self.optimizer.flattener
+        g_np = [g.detach().numpy() for g in gs]
+        p_np = [p.detach().numpy() for p in self._params]
+        flat_g = jnp.asarray(host_pack.pack_like_flattener(g_np, fl))
+        flat_p = jnp.asarray(host_pack.pack_like_flattener(p_np, fl))
+        self._state = self.optimizer.step_flat(
+            self._state._replace(master=flat_p), flat_g, scale=scale, lr=lr)
+        out = np.asarray(jax.device_get(self._state.master))
+        with torch.no_grad():
+            host_pack.unpack(out, [p.data.numpy() for p in self._params],
+                             [int(o) for o in fl.offsets[:-1]])
+        self._jax_params = None    # lazily rebuilt if the slow path runs
         return None
 
     # -- checkpointing --------------------------------------------------------
 
+    def _current_params(self):
+        if self._jax_params is None:
+            # copy=True: zero-copy aliases of live torch storage would be
+            # mutated in place by the next packed step (same hazard as the
+            # grads import above)
+            self._jax_params = [jnp.array(from_torch(p.data), copy=True)
+                                for p in self._params]
+        return self._jax_params
+
     def state_dict(self):
         return {"state": jax.device_get(self._state),
-                "params": jax.device_get(self._jax_params)}
+                "params": jax.device_get(self._current_params())}
 
     def load_state_dict(self, d):
         self._state = jax.tree_util.tree_map(jnp.asarray, d["state"])
-        self._jax_params = jax.tree_util.tree_map(jnp.asarray, d["params"])
+        saved = d["params"]
+        if isinstance(saved, dict):   # legacy "p{i}"-keyed checkpoints
+            saved = [saved[k] for k in sorted(saved, key=lambda k: int(k[1:]))]
+        self._jax_params = [jnp.asarray(x) for x in saved]
         torch = _torch()
         with torch.no_grad():
-            for i, p in enumerate(self._params):
-                p.data.copy_(to_torch(self._jax_params[f"p{i}"]))
+            for p, cur in zip(self._params, self._jax_params):
+                p.data.copy_(to_torch(cur))
 
 
 __all__ = ["from_torch", "to_torch", "TorchFusedOptimizer"]

@@ -20,6 +20,7 @@ from .mesh import (
 )
 from .distributed import DistributedDataParallel, Reducer, allreduce_tree
 from .sync_batchnorm import SyncBatchNorm, sync_batch_norm, batch_norm_stats
+from .sequence import ring_attention, ulysses_attention
 from .LARC import LARC
 
 

@@ -1,0 +1,147 @@
+"""ctypes bindings for the native host packing engine (csrc/host_pack.cpp)
+— the ``apex_C.flatten/unflatten`` runtime analog.
+
+Compiled on first use with the ambient ``g++`` (cached next to the package
+or in the user cache dir); degrades to a numpy implementation when no
+toolchain is available, so the Python API is always live:
+
+    from apex_tpu.utils import host_pack
+    flat = host_pack.pack(arrays, offsets, total)      # one buffer
+    host_pack.unpack(flat, arrays_out, offsets)        # in-place fill
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc", "host_pack.cpp")
+
+_lib = None
+_lib_tried = False
+
+
+def _build_dirs():
+    yield os.path.join(os.path.dirname(_SRC), "_build")
+    yield os.path.join(tempfile.gettempdir(), "apex_tpu_build")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_SRC):
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    for d in _build_dirs():
+        so = os.path.join(d, f"libapex_tpu_host_{tag}.so")
+        if not os.path.exists(so):
+            try:
+                os.makedirs(d, exist_ok=True)
+                tmp = so + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", "-o", tmp, _SRC],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            except Exception:
+                continue
+        try:
+            lib = ctypes.CDLL(so)
+            lib.apex_tpu_pack.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64]
+            lib.apex_tpu_unpack.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64]
+            if lib.apex_tpu_host_pack_abi() == 1:
+                _lib = lib
+                return _lib
+        except OSError:
+            continue
+    return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _as_i64(vals) -> "ctypes.Array":
+    return (ctypes.c_int64 * len(vals))(*vals)
+
+
+def pack(arrays: Sequence[np.ndarray], offsets: Sequence[int], total: int,
+         dtype=np.float32) -> np.ndarray:
+    """Pack host arrays into one (total,) buffer at ELEMENT offsets.
+    Arrays must already have the target dtype; padding gaps are zeroed."""
+    dtype = np.dtype(dtype)
+    out = np.zeros((total,), dtype)
+    arrays = [np.ascontiguousarray(a, dtype).reshape(-1) for a in arrays]
+    if len(arrays) != len(offsets):
+        raise ValueError(f"{len(arrays)} arrays vs {len(offsets)} offsets")
+    for a, off in zip(arrays, offsets):
+        if off < 0 or off + a.size > total:
+            raise ValueError(
+                f"span [{off}, {off + a.size}) exceeds total {total}")
+    lib = _load()
+    if lib is None:
+        for a, off in zip(arrays, offsets):
+            out[off:off + a.size] = a
+        return out
+    srcs = (ctypes.c_void_p * len(arrays))(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays])
+    lib.apex_tpu_pack(srcs, _as_i64([a.size for a in arrays]),
+                      _as_i64(list(offsets)), len(arrays),
+                      out.ctypes.data_as(ctypes.c_void_p), dtype.itemsize)
+    return out
+
+
+def unpack(flat: np.ndarray, outs: List[np.ndarray],
+           offsets: Sequence[int]) -> None:
+    """Fill ``outs`` in place from ELEMENT offsets of ``flat`` (same
+    dtype)."""
+    flat = np.ascontiguousarray(flat)
+    if len(outs) != len(offsets):
+        raise ValueError(f"{len(outs)} outputs vs {len(offsets)} offsets")
+    for o, off in zip(outs, offsets):
+        if off < 0 or off + o.size > flat.size:
+            raise ValueError(
+                f"span [{off}, {off + o.size}) exceeds flat {flat.size}")
+    lib = _load()
+    if lib is None:
+        for o, off in zip(outs, offsets):
+            flat_part = flat[off:off + o.size]
+            np.copyto(o.reshape(-1), flat_part)
+        return
+    for o in outs:
+        if not o.flags["C_CONTIGUOUS"]:
+            raise ValueError("unpack targets must be contiguous")
+        if o.dtype.itemsize != flat.dtype.itemsize:
+            raise ValueError("unpack dtype width mismatch")
+    dsts = (ctypes.c_void_p * len(outs))(
+        *[o.ctypes.data_as(ctypes.c_void_p) for o in outs])
+    lib.apex_tpu_unpack(flat.ctypes.data_as(ctypes.c_void_p),
+                        _as_i64([o.size for o in outs]),
+                        _as_i64(list(offsets)), len(outs), dsts,
+                        flat.dtype.itemsize)
+
+
+def pack_like_flattener(arrays, flattener, dtype=np.float32) -> np.ndarray:
+    """Pack host arrays using a TreeFlattener's offsets/total layout — the
+    staging buffer feeds ``step_flat`` after ONE host->device transfer."""
+    offs = [int(o) for o in flattener.offsets[:-1]]
+    return pack(arrays, offs, flattener.total, dtype)

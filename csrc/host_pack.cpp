@@ -1,0 +1,100 @@
+// Host-side bucket packing — the native analog of apex_C
+// (reference: csrc/flatten_unflatten.cpp:5-18, which flattens dense tensor
+// lists for DDP buckets via torch's flatten utils).
+//
+// On TPU the DEVICE-side packing collapses into XLA copies, but the
+// host-side runtime still moves tensor lists across the framework boundary
+// (torch grads -> one flat staging buffer -> a single host-to-device
+// transfer, and back).  Doing that with N numpy copies serializes on the
+// GIL; this file provides the threaded memcpy engine, exposed through
+// ctypes (no pybind dependency) by apex_tpu/utils/host_pack.py.
+//
+// Layout contract: offsets are ELEMENT offsets into a dst buffer laid out
+// by TreeFlattener (each leaf 128-lane aligned); sizes are element counts;
+// elem_size is the uniform element byte width.  Gaps (alignment padding)
+// are left untouched — callers zero the buffer once at allocation.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Span {
+  const char* src;
+  char* dst;
+  int64_t nbytes;
+};
+
+// Split the copy list into roughly equal byte shares per worker; large
+// buffers are further split so one giant leaf cannot serialize the pool.
+void run_spans(std::vector<Span>& spans, int n_threads) {
+  constexpr int64_t kSplit = 1 << 20;  // 1 MiB sub-spans
+  std::vector<Span> work;
+  work.reserve(spans.size() * 2);
+  for (const Span& s : spans) {
+    int64_t off = 0;
+    while (off < s.nbytes) {
+      int64_t n = std::min(kSplit, s.nbytes - off);
+      work.push_back({s.src + off, s.dst + off, n});
+      off += n;
+    }
+  }
+  if (work.empty()) return;
+  n_threads = std::max(1, std::min<int>(n_threads, (int)work.size()));
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  std::size_t per = (work.size() + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    std::size_t lo = t * per;
+    std::size_t hi = std::min(work.size(), lo + per);
+    if (lo >= hi) break;
+    pool.emplace_back([&work, lo, hi]() {
+      for (std::size_t i = lo; i < hi; ++i)
+        std::memcpy(work[i].dst, work[i].src, work[i].nbytes);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+int hw_threads() {
+  unsigned n = std::thread::hardware_concurrency();
+  return n ? (int)n : 4;
+}
+
+}  // namespace
+
+extern "C" {
+
+// srcs[i] -> dst + offsets[i]*elem_size, sizes[i] elements each.
+void apex_tpu_pack(const void** srcs, const int64_t* sizes,
+                   const int64_t* offsets, int64_t n, void* dst,
+                   int64_t elem_size) {
+  std::vector<Span> spans;
+  spans.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    spans.push_back({(const char*)srcs[i],
+                     (char*)dst + offsets[i] * elem_size,
+                     sizes[i] * elem_size});
+  }
+  run_spans(spans, hw_threads());
+}
+
+// src + offsets[i]*elem_size -> dsts[i], sizes[i] elements each.
+void apex_tpu_unpack(const void* src, const int64_t* sizes,
+                     const int64_t* offsets, int64_t n, void** dsts,
+                     int64_t elem_size) {
+  std::vector<Span> spans;
+  spans.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    spans.push_back({(const char*)src + offsets[i] * elem_size,
+                     (char*)dsts[i], sizes[i] * elem_size});
+  }
+  run_spans(spans, hw_threads());
+}
+
+int apex_tpu_host_pack_abi() { return 1; }
+
+}  // extern "C"

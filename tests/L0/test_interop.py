@@ -105,3 +105,60 @@ def test_state_dict_round_trip():
     opt2.step()
     np.testing.assert_allclose(p2.detach().numpy(), val_after_2.numpy(),
                                atol=1e-6)
+
+
+@pytest.mark.parametrize("impl", ["xla", "fused"])
+def test_many_params_order_stable(impl):
+    """>= 10 params: flatten order must follow the list, not lexicographic
+    key order (regression for dict-keyed trees where p10 < p2).  impl='xla'
+    exercises the generic tree path, impl='fused' (CPU fp32 contiguous) the
+    native packed path."""
+    torch.manual_seed(3)
+    ps = [torch.nn.Parameter(torch.randn(3, 4) * (i + 1))
+          for i in range(12)]
+    ref = [p.detach().clone() for p in ps]
+    opt = TorchFusedOptimizer(ps, FusedSGD(lr=0.1, impl=impl))
+    grads = [torch.full((3, 4), float(i)) for i in range(12)]
+    opt.step(grads=grads)
+    for i, (p, r) in enumerate(zip(ps, ref)):
+        np.testing.assert_allclose(p.detach().numpy(),
+                                   (r - 0.1 * i).numpy(), atol=1e-6,
+                                   err_msg=f"param {i}")
+
+
+def test_non_contiguous_params_use_generic_path():
+    """Non-contiguous CPU fp32 params must fall back to the generic path
+    (the packed path requires contiguity) and still train correctly."""
+    base = torch.randn(4, 8)
+    p = torch.nn.Parameter(base.t())          # non-contiguous view
+    assert not p.is_contiguous()
+    opt = TorchFusedOptimizer([p], FusedSGD(lr=0.5, impl="fused"))
+    before = p.detach().clone()
+    opt.step(grads=[torch.ones(8, 4)])
+    np.testing.assert_allclose(p.detach().numpy(),
+                               (before - 0.5).numpy(), rtol=1e-6)
+
+
+def test_native_host_pack_round_trip():
+    from apex_tpu.utils import host_pack
+    arrays = [np.random.RandomState(i).randn(n).astype(np.float32)
+              for i, n in enumerate([5, 128, 300])]
+    offsets = [0, 128, 256]      # 128-aligned, 256+300 <= 640
+    total = 640
+    flat = host_pack.pack(arrays, offsets, total)
+    assert flat.shape == (total,)
+    for a, off in zip(arrays, offsets):
+        np.testing.assert_array_equal(flat[off:off + a.size], a)
+    # padding gap stays zero
+    assert (flat[5:128] == 0).all()
+    outs = [np.zeros_like(a) for a in arrays]
+    host_pack.unpack(flat, outs, offsets)
+    for a, o in zip(arrays, outs):
+        np.testing.assert_array_equal(a, o)
+    # the native library should have compiled in this image (g++ baked in)
+    assert host_pack.native_available()
+    # invalid layouts raise instead of corrupting the heap
+    with pytest.raises(ValueError):
+        host_pack.pack(arrays, [0, 128, 400], total)
+    with pytest.raises(ValueError):
+        host_pack.unpack(flat, outs, [0, 128, 400])
