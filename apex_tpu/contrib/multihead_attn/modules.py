@@ -55,12 +55,22 @@ def _rng_seed_from(rng) -> jnp.ndarray:
 class SelfMultiheadAttn:
     """Self-attention over (T, B, C) inputs, reference layout and options
     (``self_multihead_attn.py:32-44``): ``bias``, ``include_norm_add``,
-    ``separate_qkv_params``, ``mask_additive``, ``impl`` in {fast, default}.
+    ``separate_qkv_params``, ``mask_additive``.
+
+    ``impl``:
+      - ``"fast"``    — Pallas flash kernel (the ``fast_*`` CUDA exts analog)
+      - ``"default"`` — jnp reference math path
+      - ``"ring"``    — sequence-parallel ring attention: call inside
+        ``shard_map`` with ``seq_parallel_axis`` bound; the (T, B, C) input
+        is this device's contiguous sequence block.  Causality is the
+        STATIC ``causal`` constructor flag (global, from block offsets);
+        per-call masks and attention dropout are out of contract and raise.
     """
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
                  include_norm_add=False, impl="fast",
-                 separate_qkv_params=False, mask_additive=False):
+                 separate_qkv_params=False, mask_additive=False,
+                 seq_parallel_axis="seq", causal=False):
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.dropout = dropout
@@ -73,10 +83,12 @@ class SelfMultiheadAttn:
         self.scaling = self.head_dim ** -0.5
         self.separate_qkv_params = separate_qkv_params
         self.mask_additive = mask_additive
+        self.seq_parallel_axis = seq_parallel_axis
+        self.causal = causal        # impl="ring" only (global causality)
         if mask_additive:
             assert not include_norm_add, \
                 "additive mask not supported with layer norm"
-        if impl not in ("fast", "default"):
+        if impl not in ("fast", "default", "ring"):
             raise AssertionError(f"Unsupported impl: {impl} !")
 
     def init_params(self, key):
@@ -160,8 +172,6 @@ class SelfMultiheadAttn:
         k = _split_heads(lin[:, :, 1, :], self.num_heads)
         v = _split_heads(lin[:, :, 2, :], self.num_heads)
 
-        bias = build_bias(mask, self.mask_additive, batch=B, sq=S, sk=S,
-                          use_time_mask=use_time_mask)
         # No rng -> no dropout on EVERY impl (the fast path must not
         # fall back to a fixed seed: a constant mask every step is
         # silently-degraded training, and attention_core already
@@ -169,7 +179,25 @@ class SelfMultiheadAttn:
         drop = (self.dropout
                 if is_training and dropout_rng is not None else 0.0)
 
-        if self.impl == "fast":
+        if self.impl == "ring":
+            # sequence-parallel path (dispatched before build_bias: the
+            # ring takes no bias).  Causality is the STATIC constructor
+            # flag — a per-call local mask cannot express global structure
+            # under sequence sharding; masks/dropout are out of contract.
+            if drop > 0.0:
+                raise NotImplementedError(
+                    "impl='ring' does not support attention dropout")
+            if mask is not None:
+                raise NotImplementedError(
+                    "impl='ring' takes causality from the constructor "
+                    "causal= flag; per-call masks are unsupported")
+            from ...parallel.sequence import ring_attention
+            ctx = ring_attention(q, k, v, axis_name=self.seq_parallel_axis,
+                                 causal=self.causal, scale=1.0)
+            bias = None
+        elif self.impl == "fast":
+            bias = build_bias(mask, self.mask_additive, batch=B, sq=S, sk=S,
+                              use_time_mask=use_time_mask)
             H, D = self.num_heads, self.head_dim
             causal = use_time_mask and _is_causal_mask(mask)
             if causal:
@@ -181,6 +209,8 @@ class SelfMultiheadAttn:
                 _rng_seed_from(dropout_rng), causal, drop, H)
             ctx = ctx.reshape(B, H, S, D)
         else:
+            bias = build_bias(mask, self.mask_additive, batch=B, sq=S, sk=S,
+                              use_time_mask=use_time_mask)
             ctx = attention_core(q, k, v, bias, dropout_rate=drop,
                                  dropout_rng=dropout_rng,
                                  heads=self.num_heads)

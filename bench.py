@@ -203,6 +203,68 @@ def bench_rn50(on_tpu):
             "model": "resnet50" if on_tpu else "resnet18"}
 
 
+def bench_bert_e2e(on_tpu):
+    """Full BERT-style training step (fwd + bwd + amp-O5 + FusedLAMB +
+    global-norm clip) — BASELINE config-4's measurement vehicle.  NOTE:
+    runs HALF-DEPTH bert-large (12 of 24 layers) to fit the bench's time
+    budget on one chip; the detail JSON names the depth so the number is
+    never mistaken for full BERT-large."""
+    from apex_tpu import amp
+
+    if on_tpu:
+        cfg = bert_large_config(num_layers=12, dtype=jnp.bfloat16)
+        batch, seq = 8, 512
+    else:
+        cfg = bert_large_config(num_layers=2, d_model=256, d_ff=1024,
+                                vocab_size=4096, max_len=128, num_heads=4,
+                                dtype=jnp.bfloat16)
+        batch, seq = 2, 64
+    _log(f"bert e2e leg: layers={cfg.num_layers} batch={batch} seq={seq}")
+    params = jax.jit(lambda: transformer_init(jax.random.PRNGKey(0), cfg))()
+    n_params = int(sum(p.size for p in jax.tree_util.tree_leaves(params)))
+    opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=1.0,
+                    impl="xla")
+    state = amp.initialize(params, opt, opt_level="O5", verbosity=0)
+    del params
+    gc.collect()
+
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    targets = jnp.ones((batch, seq), jnp.int32)
+
+    @jax.jit
+    def train_step(state):
+        def loss_fn(p):
+            from apex_tpu.models import transformer_loss
+            return amp.scale_loss(transformer_loss(
+                p, {"tokens": tokens, "targets": targets}, cfg), state)
+
+        grads = jax.grad(loss_fn)(state.model_params)
+        return amp.amp_step(state, grads)
+
+    _log("compiling bert e2e train step ...")
+    state = train_step(state)
+    _sync(state.scalers[0].loss_scale)
+    _log("timing bert e2e train step ...")
+
+    def run(n, state):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state = train_step(state)
+        _sync(jax.tree_util.tree_leaves(state.master_params)[0])
+        return time.perf_counter() - t0, state
+
+    t1, state = run(2, state)
+    t2, state = run(8, state)
+    ms = (t2 - t1) / 6 * 1e3
+    seq_per_s = batch / (ms / 1e3)
+    _log(f"bert e2e: {ms:.1f} ms/step, {seq_per_s:.2f} sequences/sec")
+    return {"step_ms": round(ms, 2), "sequences_per_sec": round(seq_per_s, 2),
+            "batch": batch, "seq": seq, "layers": cfg.num_layers,
+            "model": ("bert-large-half-depth-12of24" if on_tpu
+                      else "bert-tiny-cpu"),
+            "n_params": n_params}
+
+
 def run_bench(budget_left=lambda: 1e9):
     on_tpu = jax.default_backend() == "tpu"
     _log(f"backend={jax.default_backend()} devices={len(jax.devices())}")
@@ -239,6 +301,14 @@ def run_bench(budget_left=lambda: 1e9):
             detail["rn50"] = {"error": repr(err)[:200]}
     else:
         _log("skipping rn50 leg (budget)")
+    gc.collect()
+    if budget_left() > 100:
+        try:
+            detail["bert_e2e"] = bench_bert_e2e(on_tpu)
+        except Exception as err:
+            detail["bert_e2e"] = {"error": repr(err)[:200]}
+    else:
+        _log("skipping bert e2e leg (budget)")
 
     return {
         "metric": "fused_lamb_step_ms_bert_large",

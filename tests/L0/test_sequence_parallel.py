@@ -120,3 +120,34 @@ def test_ulysses_rejects_ragged_heads():
         def sharded(q, k, v):
             return ulysses_attention(q, k, v, axis_name="seq")
         sharded(q, q, q)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_self_mha_ring_impl_matches_default(causal):
+    """SelfMultiheadAttn(impl='ring') inside shard_map == impl='default'
+    unsharded (module-level integration of sequence parallelism)."""
+    from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+    E, HEADS = 32, 4
+    mha_ring = SelfMultiheadAttn(E, HEADS, impl="ring", causal=causal)
+    mha_ref = SelfMultiheadAttn(E, HEADS, impl="default")
+    params = mha_ring.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (S, B, E))  # (T, B, C)
+    tmask = (jnp.triu(jnp.ones((S, S)), 1) > 0) if causal else None
+
+    ref, _ = mha_ref(params, x, attn_mask=tmask, is_training=False)
+
+    mesh = _mesh()
+    xspec = P("seq", None, None)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params), xspec),
+        out_specs=xspec)
+    def sharded(params, x):
+        out, _ = mha_ring(params, x, is_training=False)
+        return out
+
+    out = sharded(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
