@@ -51,6 +51,13 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
     Must be called inside a context where ``axis_name`` is bound (shard_map /
     pmap).  Outside any mapped context it is an identity (world size 1), like
     the reference with ``torch.distributed`` uninitialized.
+
+    vma-typed shard_map note: gradients taken wrt REPLICATED (unvarying)
+    params are already psum-SUMMED by the cotangent rule.  This function
+    inspects each leaf's varying-axes type and SKIPS the redundant psum for
+    already-reduced leaves (still applying the average/predivide scaling),
+    so DDP semantics hold whether grads arrive per-device (pmap, lifted
+    params, check_vma=False) or pre-summed (replicated params under vma).
     """
     if not axis_is_bound(axis_name):
         return grads
@@ -67,10 +74,20 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
     elif average:
         post = 1.0 / world
 
+    from ..utils.pallas import _vma_of
+
     def reduce_leaf(g):
         orig_dtype = g.dtype
         if always_fp32 and orig_dtype != jnp.float32:
             g = g.astype(jnp.float32)
+        vma = _vma_of(g)
+        already_summed = vma is not None and axis_name not in vma
+        if already_summed:
+            # the cotangent psum ran; only the (pre*post) scaling remains
+            scale = pre * post
+            if scale != 1.0:
+                g = g * scale
+            return g.astype(orig_dtype)
         if pre != 1.0:
             g = g * pre
         g = jax.lax.psum(g, axis_name)
