@@ -340,7 +340,25 @@ def main():
 
     deadline = time.monotonic() + 360.0   # leave room for the CPU fallback
     attempt_errs = []
-    for attempt in range(2):
+
+    # cheap health probe first: a wedged tunnel hangs ANY client at backend
+    # init, so burning the full budget on the real bench tells us nothing a
+    # 75s probe doesn't
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=75)
+        healthy = r.returncode == 0
+        if not healthy:
+            attempt_errs.append(f"probe rc={r.returncode}: "
+                                + (r.stderr or "")[-150:])
+    except subprocess.TimeoutExpired:
+        healthy = False
+        attempt_errs.append("probe timeout (tunnel wedged)")
+    attempts = 2 if healthy else 0
+
+    for attempt in range(attempts):
         budget = deadline - time.monotonic()
         if budget < 60:
             break
