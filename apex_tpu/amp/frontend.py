@@ -60,6 +60,8 @@ class AmpState:
 
     def params_for_eval(self):
         """fp32 view of params (the O2 state_dict hook, _initialize.py:133-142)."""
+        if _flat_masters_active(self):
+            return _master_flattener(self).unflatten(self.opt_state.master)
         src = self.master_params if self.master_params is not None else self.model_params
         return jax.tree_util.tree_map(
             lambda p: p.astype(jnp.float32)
@@ -119,10 +121,44 @@ def initialize(params, optimizer=None, opt_level="O1", *,
     if optimizer is not None:
         target = masters if masters is not None else model_params
         opt_state = optimizer.init(target)
+        if masters is not None and _is_fused_flat(optimizer):
+            # flat fast path: the fused state's flat buffer IS the master
+            # (authoritative, like the contrib FP16_Optimizer) — a second
+            # tree copy would double master memory and force per-step
+            # repacking (PERF_NOTES §1)
+            masters = None
 
     return AmpState(model_params=model_params, master_params=masters,
                     scalers=scalers, opt_state=opt_state, properties=props,
                     optimizer=optimizer)
+
+
+def _is_fused_flat(optimizer) -> bool:
+    return getattr(optimizer, "impl", None) == "fused"
+
+
+def _flat_masters_active(amp_state: AmpState) -> bool:
+    """True when masters live flat inside the fused optimizer state.
+    Gated on ``properties.master_weights``: a fused optimizer's state always
+    carries a flat ``master`` buffer, but at master_weights=False levels
+    (O0/O1/O3) it holds MODEL-dtype values semantically, not fp32 masters."""
+    return (amp_state.master_params is None
+            and amp_state.optimizer is not None
+            and _is_fused_flat(amp_state.optimizer)
+            and bool(amp_state.properties is not None
+                     and amp_state.properties.master_weights)
+            and getattr(amp_state.opt_state, "master", None) is not None)
+
+
+def _master_flattener(amp_state: AmpState):
+    """Packing plan for THIS state's master layout (fp32 leaves with the
+    model tree's structure/shapes).  Re-keys the optimizer's flattener cache
+    so a single optimizer object shared across amp states always operates
+    with the plan matching the state being stepped."""
+    ref = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32),
+        amp_state.model_params)
+    return amp_state.optimizer.flattener_for(ref)
 
 
 def scale_loss(loss, amp_state: AmpState, loss_id: int = 0):
@@ -170,6 +206,25 @@ def amp_step_multi(amp_state: AmpState, grads_and_ids, *, lr=None):
     for f in finites.values():
         all_finite = f if all_finite is None else (all_finite & f)
 
+    scalers = tuple(
+        _scaler.update(s, finites[i]) if i in finites else s
+        for i, s in enumerate(amp_state.scalers))
+
+    if _flat_masters_active(amp_state):
+        # flat fast path: pack grads once, update the flat master in place,
+        # one fused unflatten-with-cast produces the model copy
+        opt = amp_state.optimizer
+        fl = _master_flattener(amp_state)
+        new_opt_state = opt.step_flat(amp_state.opt_state,
+                                      fl.flatten(total32), lr=lr)
+        new_opt_state = _scaler.apply_if_finite(all_finite, new_opt_state,
+                                                amp_state.opt_state)
+        model_params = fl.unflatten(new_opt_state.master,
+                                    like=amp_state.model_params)
+        return amp_state._replace(model_params=model_params,
+                                  scalers=scalers,
+                                  opt_state=new_opt_state)
+
     masters = (amp_state.master_params if amp_state.master_params is not None
                else amp_state.model_params)
     new_masters, new_opt_state = amp_state.optimizer.step(
@@ -177,9 +232,6 @@ def amp_step_multi(amp_state: AmpState, grads_and_ids, *, lr=None):
     new_masters = _scaler.apply_if_finite(all_finite, new_masters, masters)
     new_opt_state = _scaler.apply_if_finite(all_finite, new_opt_state,
                                             amp_state.opt_state)
-    scalers = tuple(
-        _scaler.update(s, finites[i]) if i in finites else s
-        for i, s in enumerate(amp_state.scalers))
 
     if amp_state.master_params is not None:
         model_params = _pt.master_to_model(new_masters, amp_state.model_params)
@@ -192,6 +244,9 @@ def amp_step_multi(amp_state: AmpState, grads_and_ids, *, lr=None):
 
 def master_params(amp_state: AmpState):
     """Iterate master (fp32) params — ``amp.master_params`` (_amp_state.py:58-68)."""
+    if _flat_masters_active(amp_state):
+        return jax.tree_util.tree_leaves(
+            _master_flattener(amp_state).unflatten(amp_state.opt_state.master))
     src = (amp_state.master_params if amp_state.master_params is not None
            else amp_state.model_params)
     return jax.tree_util.tree_leaves(src)

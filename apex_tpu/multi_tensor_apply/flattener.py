@@ -92,13 +92,24 @@ class TreeFlattener:
         return out
 
     def unflatten(self, flat, like=None, dtype=None):
-        """Unpack (total,) buffer -> tree.  ``dtype=None`` restores each leaf's
-        original dtype; pass e.g. jnp.float32 to force."""
+        """Unpack (total,) buffer -> tree.
+
+        Per-leaf target dtype precedence: explicit ``dtype`` > the matching
+        leaf of ``like`` (same structure; the one-pass master->model copy,
+        e.g. bf16 model params with keep_batchnorm leaves fp32) > the
+        dtypes recorded at build time."""
+        like_leaves = (self.treedef.flatten_up_to(like)
+                       if like is not None else None)
         leaves = []
         for i in range(self.num_leaves):
             off = int(self.offsets[i])
             piece = jax.lax.slice(flat, (off,), (off + self.sizes[i],))
-            tgt = dtype or self.dtypes[i]
+            if dtype is not None:
+                tgt = dtype
+            elif like_leaves is not None:
+                tgt = like_leaves[i].dtype
+            else:
+                tgt = self.dtypes[i]
             leaves.append(piece.reshape(self.shapes[i]).astype(tgt))
         return self.treedef.unflatten(leaves)
 
