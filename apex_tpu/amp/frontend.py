@@ -121,11 +121,14 @@ def initialize(params, optimizer=None, opt_level="O1", *,
     if optimizer is not None:
         target = masters if masters is not None else model_params
         opt_state = optimizer.init(target)
-        if masters is not None and _is_fused_flat(optimizer):
+        if (masters is not None and _is_fused_flat(optimizer)
+                and getattr(opt_state, "master", None) is not None):
             # flat fast path: the fused state's flat buffer IS the master
             # (authoritative, like the contrib FP16_Optimizer) — a second
             # tree copy would double master memory and force per-step
-            # repacking (PERF_NOTES §1)
+            # repacking (PERF_NOTES §1).  Gated on the state actually
+            # carrying a flat master: sharded optimizers (DistributedFused*)
+            # keep per-device `p` shards instead and need the tree masters.
             masters = None
 
     return AmpState(model_params=model_params, master_params=masters,

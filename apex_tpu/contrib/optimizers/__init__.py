@@ -5,6 +5,8 @@ flat fused FP16_Optimizer (reference:
 from .distributed_fused import (DistributedFusedAdam, DistributedFusedLAMB,
                                 ShardedAdamState, ShardedLAMBState)
 from .fp16_optimizer import FP16_Optimizer
+from . import deprecated
 
 __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
-           "ShardedAdamState", "ShardedLAMBState", "FP16_Optimizer"]
+           "ShardedAdamState", "ShardedLAMBState", "FP16_Optimizer",
+           "deprecated"]

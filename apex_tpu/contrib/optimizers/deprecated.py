@@ -1,0 +1,141 @@
+"""Deprecated contrib optimizer API shapes (reference:
+``apex/contrib/optimizers/fused_adam.py`` / ``fused_lamb.py`` /
+``fused_sgd.py`` — the pre-``apex.optimizers`` classes whose ``step`` takes
+``grads=``, ``output_params=``, ``scale=`` explicitly).
+
+These exist for scripts ported verbatim from the deprecated API.  They are
+thin stateful facades over the modern fused optimizers: the extra
+capabilities the deprecated kernels carried (reversible step / undo,
+compressed all-gather) live in the modern components (`DistributedFused*`'s
+select-revert and ``bf16_allgather``).  A DeprecationWarning points at the
+replacement, mirroring the reference's own deprecation notices.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...optimizers import (FusedAdam as _ModernAdam,
+                           FusedLAMB as _ModernLAMB,
+                           FusedSGD as _ModernSGD)
+
+
+class _DeprecatedFacade:
+    _modern_cls: Any = None
+    _replacement = ""
+
+    def __init__(self, params, **kw):
+        warnings.warn(
+            f"apex_tpu.contrib.optimizers.{type(self).__name__} is "
+            f"deprecated (as in the reference); use {self._replacement}",
+            DeprecationWarning, stacklevel=3)   # past the subclass __init__
+        self._params = params
+        self.optimizer = self._modern_cls(**kw)
+        self.state = self.optimizer.init(params)
+
+    _max_grad_norm = 0.0
+
+    def step(self, grads=None, output_params=None, scale=1.0,
+             grad_norms=None):
+        """Deprecated step contract: explicit ``grads`` (required here — a
+        functional world has no ``.grad`` attribute), optional
+        ``output_params`` dtype hint for low-precision copies, ``scale``
+        dividing the grads (fused_adam.py:175 ``adam(..., scale)``).
+        ``grad_norms`` (precomputed norms) is not supported — pass raw
+        grads and let the facade clip."""
+        if grads is None:
+            raise ValueError("the functional deprecated API requires "
+                             "step(grads=...)")
+        if grad_norms is not None:
+            raise NotImplementedError(
+                "step(grad_norms=...) is unsupported; the facade computes "
+                "norms itself when max_grad_norm is set")
+        if self._max_grad_norm and self._max_grad_norm > 0:
+            # the deprecated Adam folds global-norm clipping into the
+            # update scale (fused_adam.py combined_scale); the modern LAMB
+            # clips internally, so this only fires for Adam/SGD facades
+            from ...optimizers._base import global_l2norm
+            gnorm = global_l2norm(grads) / scale
+            clip = jnp.maximum(1.0, gnorm / self._max_grad_norm)
+            scale = scale * clip
+        new_params, self.state = self.optimizer.step(
+            self.state, grads, self._params, scale=scale)
+        self._params = new_params
+        if output_params is not None:
+            out_dtype = (output_params if not hasattr(output_params, "dtype")
+                         else output_params.dtype)
+            return jax.tree_util.tree_map(
+                lambda p: p.astype(out_dtype), new_params)
+        return new_params
+
+    @property
+    def params(self):
+        return self._params
+
+    def state_dict(self):
+        return {"params": self._params, "state": self.state}
+
+    def load_state_dict(self, d):
+        self._params = d["params"]
+        self.state = d["state"]
+
+
+class FusedAdam(_DeprecatedFacade):
+    """Deprecated contrib FusedAdam (``fused_adam.py:38``)."""
+    _modern_cls = _ModernAdam
+    _replacement = "apex_tpu.optimizers.FusedAdam"
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
+                 weight_decay=0.0, max_grad_norm=0.0, amsgrad=False,
+                 use_mt=False, amp_scale_adjustment=1.0):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedAdam does not support the AMSGrad variant.")
+        if eps_inside_sqrt:
+            # changes the denominator math (sqrt(v + eps) vs sqrt(v) + eps);
+            # silently ignoring it would alter trajectories
+            raise NotImplementedError(
+                "eps_inside_sqrt=True is not implemented; use the default "
+                "eps mode")
+        del use_mt, amp_scale_adjustment   # launch-latency knobs: no-op
+        super().__init__(params, lr=lr, bias_correction=bias_correction,
+                         betas=betas, eps=eps, weight_decay=weight_decay,
+                         adam_w_mode=False)
+        self._max_grad_norm = max_grad_norm
+
+
+class FusedLAMB(_DeprecatedFacade):
+    """Deprecated contrib FusedLAMB (``fused_lamb.py``)."""
+    _modern_cls = _ModernLAMB
+    _replacement = "apex_tpu.optimizers.FusedLAMB"
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 amsgrad=False, adam_w_mode=True, grad_averaging=True,
+                 set_grad_none=True, max_grad_norm=1.0, use_nvlamb=False):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support AMSGrad")
+        super().__init__(params, lr=lr, bias_correction=bias_correction,
+                         betas=betas, eps=eps, weight_decay=weight_decay,
+                         adam_w_mode=adam_w_mode,
+                         grad_averaging=grad_averaging,
+                         max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb)
+
+
+class FusedSGD(_DeprecatedFacade):
+    """Deprecated contrib FusedSGD (``fused_sgd.py``)."""
+    _modern_cls = _ModernSGD
+    _replacement = "apex_tpu.optimizers.FusedSGD"
+
+    def __init__(self, params, lr, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False, wd_after_momentum=False,
+                 materialize_master_grads=True):
+        del materialize_master_grads
+        super().__init__(params, lr=lr, momentum=momentum,
+                         dampening=dampening, weight_decay=weight_decay,
+                         nesterov=nesterov,
+                         wd_after_momentum=wd_after_momentum)

@@ -192,3 +192,64 @@ def test_contrib_fp16_optimizer_flat():
     opt2 = CFP16(FusedAdam(lr=1e-2, impl="fused"), params)
     opt2.load_state_dict(sd)
     assert opt2.loss_scale == opt.loss_scale
+
+
+# -- deprecated contrib optimizer API shapes ---------------------------------
+
+def test_deprecated_contrib_optimizers():
+    from apex_tpu.contrib.optimizers import deprecated
+    from apex_tpu.optimizers import FusedAdam as ModernAdam
+    import warnings
+
+    params = {"w": jnp.ones((8, 8)) * 0.3}
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        opt = deprecated.FusedAdam(params, lr=1e-2)
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+    g = {"w": jnp.full((8, 8), 0.5) * 64.0}
+    p1 = opt.step(grads=g, scale=64.0)
+    # oracle: modern classic-Adam (the deprecated class is L2 mode)
+    m = ModernAdam(lr=1e-2, adam_w_mode=False)
+    st = m.init(params)
+    pref, _ = m.step(st, {"w": jnp.full((8, 8), 0.5)}, params)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(pref["w"]),
+                               atol=1e-6)
+    # output_params low-precision copy + required-grads error
+    p16 = opt.step(grads=g, scale=64.0, output_params=jnp.float16)
+    assert p16["w"].dtype == jnp.float16
+    with pytest.raises(ValueError):
+        opt.step()
+    # LAMB/SGD shapes construct and step
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ol = deprecated.FusedLAMB(params, lr=1e-2)
+        ol.step(grads={"w": jnp.ones((8, 8))})
+        os_ = deprecated.FusedSGD(params, lr=0.1, momentum=0.9)
+        os_.step(grads={"w": jnp.ones((8, 8))})
+
+
+def test_deprecated_adam_max_grad_norm_clips():
+    from apex_tpu.contrib.optimizers import deprecated
+    from apex_tpu.optimizers import FusedAdam as ModernAdam
+    import warnings
+
+    params = {"w": jnp.ones((8, 8)) * 0.3}
+    big = {"w": jnp.full((8, 8), 10.0)}      # gnorm = 80
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        opt = deprecated.FusedAdam(params, lr=1e-2, max_grad_norm=1.0)
+    p1 = opt.step(grads=big)
+    # oracle: modern adam on the clipped grads (g * 1/80)
+    m = ModernAdam(lr=1e-2, adam_w_mode=False)
+    st = m.init(params)
+    gnorm = float(jnp.sqrt(jnp.sum(big["w"] ** 2)))
+    pref, _ = m.step(st, {"w": big["w"] / gnorm}, params)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(pref["w"]),
+                               atol=1e-6)
+    with pytest.raises(NotImplementedError):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            deprecated.FusedAdam(params, eps_inside_sqrt=True)
+    with pytest.raises(NotImplementedError):
+        opt.step(grads=big, grad_norms=[1.0])
