@@ -81,14 +81,18 @@ def time_apex_xla(make_params, grads):
     return ms
 
 
-def time_apex_fused_flat(make_params, grads):
+def time_apex_fused_flat(make_params, grads, grad_dtype=None):
     """The flat engine's native loop: state (master+m+v) permanently flat,
-    grads arrive flat (as produced by a flat-native train step)."""
+    grads arrive flat (as produced by a flat-native train step).
+    ``grad_dtype=bfloat16`` measures the O5 flat-native case where grads
+    come off the backward in bf16 (half the gradient read bandwidth)."""
     opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=1.0,
                     impl="fused")
     params = make_params()
     state = opt.init(params)
     flat_g = jax.jit(opt.flattener.flatten)(grads)
+    if grad_dtype is not None:
+        flat_g = flat_g.astype(grad_dtype)
     _sync(flat_g)
     del params
     gc.collect()
@@ -281,15 +285,21 @@ def run_bench(budget_left=lambda: 1e9):
 
     xla_ms = time_apex_xla(make_params, grads)
     fused_ms = time_apex_fused_flat(make_params, grads)
+    fused_bf16_ms = time_apex_fused_flat(make_params, grads,
+                                         grad_dtype=jnp.bfloat16)
     base_ms = time_optax(make_params, grads)
     del grads
     gc.collect()
+    # headline stays apples-to-apples with the fp32-grads optax baseline;
+    # the bf16-grads flat number (the O5 flat-native case) is reported but
+    # never hidden inside `value`
     best_ms = min(xla_ms, fused_ms)
     winner = "fused_flat" if fused_ms <= xla_ms else "xla"
 
     detail = {"optax_baseline_ms": round(base_ms, 3),
               "xla_impl_ms": round(xla_ms, 3),
               "fused_flat_impl_ms": round(fused_ms, 3),
+              "fused_flat_bf16grads_ms": round(fused_bf16_ms, 3),
               "winner": winner,
               "backend": jax.default_backend(),
               "n_params": n_params}
