@@ -65,6 +65,9 @@ class SelfMultiheadAttn:
         is this device's contiguous sequence block.  Causality is the
         STATIC ``causal`` constructor flag (global, from block offsets);
         per-call masks and attention dropout are out of contract and raise.
+      - ``"ulysses"`` — sequence-parallel via all_to_all seq<->heads
+        re-sharding (num_heads must divide the axis size); same contract
+        as "ring" (constructor ``causal``, no masks/dropout).
     """
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
@@ -88,7 +91,7 @@ class SelfMultiheadAttn:
         if mask_additive:
             assert not include_norm_add, \
                 "additive mask not supported with layer norm"
-        if impl not in ("fast", "default", "ring"):
+        if impl not in ("fast", "default", "ring", "ulysses"):
             raise AssertionError(f"Unsupported impl: {impl} !")
 
     def init_params(self, key):
@@ -179,21 +182,25 @@ class SelfMultiheadAttn:
         drop = (self.dropout
                 if is_training and dropout_rng is not None else 0.0)
 
-        if self.impl == "ring":
-            # sequence-parallel path (dispatched before build_bias: the
-            # ring takes no bias).  Causality is the STATIC constructor
-            # flag — a per-call local mask cannot express global structure
-            # under sequence sharding; masks/dropout are out of contract.
+        if self.impl in ("ring", "ulysses"):
+            # sequence-parallel paths (dispatched before build_bias: they
+            # take no bias).  Causality is the STATIC constructor flag — a
+            # per-call local mask cannot express global structure under
+            # sequence sharding; masks/dropout are out of contract.
             if drop > 0.0:
                 raise NotImplementedError(
-                    "impl='ring' does not support attention dropout")
+                    f"impl={self.impl!r} does not support attention dropout")
             if mask is not None:
                 raise NotImplementedError(
-                    "impl='ring' takes causality from the constructor "
-                    "causal= flag; per-call masks are unsupported")
-            from ...parallel.sequence import ring_attention
-            ctx = ring_attention(q, k, v, axis_name=self.seq_parallel_axis,
-                                 causal=self.causal, scale=1.0)
+                    f"impl={self.impl!r} takes causality from the "
+                    "constructor causal= flag; per-call masks are "
+                    "unsupported")
+            from ...parallel.sequence import (ring_attention,
+                                              ulysses_attention)
+            seq_fn = (ring_attention if self.impl == "ring"
+                      else ulysses_attention)
+            ctx = seq_fn(q, k, v, axis_name=self.seq_parallel_axis,
+                         causal=self.causal, scale=1.0)
             bias = None
         elif self.impl == "fast":
             bias = build_bias(mask, self.mask_additive, batch=B, sq=S, sk=S,

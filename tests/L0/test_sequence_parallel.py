@@ -122,14 +122,15 @@ def test_ulysses_rejects_ragged_heads():
         sharded(q, q, q)
 
 
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_self_mha_ring_impl_matches_default(causal):
-    """SelfMultiheadAttn(impl='ring') inside shard_map == impl='default'
-    unsharded (module-level integration of sequence parallelism)."""
+def test_self_mha_ring_impl_matches_default(causal, impl):
+    """SelfMultiheadAttn(impl='ring'|'ulysses') inside shard_map ==
+    impl='default' unsharded (module-level sequence parallelism)."""
     from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
 
-    E, HEADS = 32, 4
-    mha_ring = SelfMultiheadAttn(E, HEADS, impl="ring", causal=causal)
+    E, HEADS = 32, 8       # 8 heads divide the 8-device axis (ulysses)
+    mha_ring = SelfMultiheadAttn(E, HEADS, impl=impl, causal=causal)
     mha_ref = SelfMultiheadAttn(E, HEADS, impl="default")
     params = mha_ring.init_params(jax.random.PRNGKey(0))
     x = jax.random.normal(jax.random.PRNGKey(1), (S, B, E))  # (T, B, C)
