@@ -10,6 +10,7 @@ Entry points:
 
 from . import scaler
 from .scaler import LossScaler, ScalerState
+from .handle import AmpHandle, NoOpHandle, OptimWrapper, init_handle
 from .properties import Properties, opt_levels
 from .amp import (
     init,

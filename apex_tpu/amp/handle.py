@@ -1,0 +1,152 @@
+"""Legacy amp handle API (reference: ``apex/amp/handle.py:170-252``
+``AmpHandle``/``NoOpHandle`` and ``apex/amp/opt.py:9-103`` ``OptimWrapper``).
+
+The reference deprecated this surface in favor of ``amp.initialize`` (its
+own ``AmpHandle.scale_loss`` raises "The old Amp API is no longer
+supported") but the classes remain part of the package.  Here they are
+live, re-expressed functionally: no ``.grad`` mutation or step patching —
+the handle owns scaler state and exposes the scale/unscale/skip pipeline
+as explicit calls:
+
+    handle = amp.init_handle(loss_scale="dynamic")
+    scaled = handle.scale_loss(loss)          # use in your grad fn
+    grads32, skip = handle.unscale_and_update(grads)
+    if not skip: params, opt_state = opt.step(opt_state, grads32, params)
+
+``OptimWrapper`` carries the per-loss scalers for multi-loss training
+(``wrap_optimizer(opt, num_loss=3)``) with the same explicit flow per
+loss_id.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import scaler as _scaler
+
+
+class AmpHandle:
+    """Stateful convenience over the pure scaler (handle.py:170-252)."""
+
+    def __init__(self, loss_scale="dynamic", enable_caching=True,
+                 verbose=False):
+        self._enable_caching = enable_caching
+        self._verbose = verbose
+        self._scaler_state = _scaler.init(loss_scale)
+        self._is_active = True
+        self._wrapped = False
+
+    def is_active(self):
+        return self._is_active
+
+    @contextlib.contextmanager
+    def _disable_casts(self):
+        self._is_active = False
+        yield
+        self._is_active = True
+
+    @property
+    def loss_scale(self):
+        return float(self._scaler_state.loss_scale)
+
+    def scale_loss(self, loss):
+        """Scaled loss for the backward (the context manager's yield)."""
+        if not self._is_active:
+            return loss
+        if self._wrapped:
+            raise RuntimeError(
+                "After calling `handle.wrap_optimizer()`, use "
+                "`wrapper.scale_loss(loss, loss_id)` (handle.py:202-205)")
+        return _scaler.scale_loss(self._scaler_state, loss)
+
+    def unscale_and_update(self, grads):
+        """Unscale grads, update the dynamic scale from the overflow check.
+        Returns (grads32, should_skip) — the explicit form of the context
+        manager's exit (unscale -> update_scale -> skip-step patch)."""
+        g32, finite = _scaler.unscale(self._scaler_state, grads)
+        self._scaler_state = _scaler.update(self._scaler_state, finite)
+        return g32, not bool(finite)
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        self._wrapped = True
+        return OptimWrapper(optimizer, self, num_loss)
+
+    # cache surface kept for API parity (the functional cast path keys its
+    # cache inside amp.autocast, so these are bookkeeping only)
+    @property
+    def has_cache(self):
+        return self._enable_caching
+
+    @property
+    def verbose(self):
+        return self._verbose
+
+    def state_dict(self):
+        return {"loss_scaler0": _scaler.state_dict(self._scaler_state)}
+
+    def load_state_dict(self, d):
+        self._scaler_state = _scaler.load_state_dict(d["loss_scaler0"])
+
+
+class NoOpHandle:
+    """Disabled-amp handle (handle.py:255-280): everything passes through."""
+
+    def is_active(self):
+        return False
+
+    @contextlib.contextmanager
+    def _disable_casts(self):
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def unscale_and_update(self, grads):
+        return grads, False
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        return optimizer
+
+    @property
+    def has_cache(self):
+        return False
+
+
+class OptimWrapper:
+    """Per-loss scaler bookkeeping for the legacy multi-loss flow
+    (opt.py:9-103), functional: each loss_id gets its own dynamic scaler;
+    the caller accumulates unscaled grads and steps once."""
+
+    def __init__(self, optimizer, amp_handle, num_loss=1):
+        self._optimizer = optimizer
+        self._handle = amp_handle
+        self._scalers = [_scaler.init("dynamic") for _ in range(num_loss)]
+
+    def loss_scale(self, loss_id=0):
+        return float(self._scalers[loss_id].loss_scale)
+
+    def scale_loss(self, loss, loss_id=0):
+        if not self._handle.is_active():
+            return loss
+        return _scaler.scale_loss(self._scalers[loss_id], loss)
+
+    def unscale_and_update(self, grads, loss_id=0):
+        g32, finite = _scaler.unscale(self._scalers[loss_id], grads)
+        self._scalers[loss_id] = _scaler.update(self._scalers[loss_id],
+                                                finite)
+        return g32, not bool(finite)
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+
+def init_handle(loss_scale="dynamic", enabled=True, enable_caching=True,
+                verbose=False):
+    """``amp.init()``-era entry point returning a handle (amp.py:75's
+    legacy return value)."""
+    if not enabled:
+        return NoOpHandle()
+    return AmpHandle(loss_scale, enable_caching, verbose)

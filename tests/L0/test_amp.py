@@ -197,3 +197,53 @@ def test_multiple_losses_independent_scalers():
     st = amp.frontend.amp_step(st, bad, loss_id=1)
     assert float(st.scalers[0].loss_scale) == 2.0 ** 16
     assert float(st.scalers[1].loss_scale) == 2.0 ** 15
+
+
+# -- legacy handle API (handle.py:170-252, opt.py:9-103) ---------------------
+
+def test_legacy_amp_handle_flow():
+    from apex_tpu.amp import init_handle, NoOpHandle
+    import numpy as np
+
+    h = init_handle(loss_scale="dynamic")
+    s0 = h.loss_scale
+    loss = jnp.float32(2.0)
+    assert float(h.scale_loss(loss)) == 2.0 * s0
+    g = {"w": jnp.ones((4,)) * s0}
+    g32, skip = h.unscale_and_update(g)
+    assert not skip
+    np.testing.assert_allclose(np.asarray(g32["w"]), 1.0)
+    # overflow path: halve + skip
+    bad = {"w": jnp.full((4,), jnp.inf)}
+    _, skip = h.unscale_and_update(bad)
+    assert skip and h.loss_scale == s0 / 2
+    # state dict round trip
+    h2 = init_handle()
+    h2.load_state_dict(h.state_dict())
+    assert h2.loss_scale == h.loss_scale
+
+    # disabled -> NoOpHandle passthrough
+    nh = init_handle(enabled=False)
+    assert isinstance(nh, NoOpHandle)
+    assert float(nh.scale_loss(loss)) == 2.0
+    _, skip = nh.unscale_and_update(bad)
+    assert not skip
+
+
+def test_legacy_optim_wrapper_multi_loss():
+    from apex_tpu.amp import init_handle
+    from apex_tpu.optimizers import FusedSGD
+    import numpy as np
+
+    h = init_handle()
+    opt = h.wrap_optimizer(FusedSGD(lr=0.1), num_loss=2)
+    with pytest.raises(RuntimeError):
+        h.scale_loss(jnp.float32(1.0))   # must go through the wrapper now
+    s0, s1 = opt.loss_scale(0), opt.loss_scale(1)
+    g0, skip0 = opt.unscale_and_update({"w": jnp.ones((4,)) * s0}, 0)
+    g1, skip1 = opt.unscale_and_update(
+        {"w": jnp.full((4,), jnp.inf)}, 1)
+    assert not skip0 and skip1
+    assert opt.loss_scale(1) == s1 / 2 and opt.loss_scale(0) >= s0
+    # attribute passthrough to the wrapped optimizer
+    assert opt.lr == 0.1
