@@ -14,6 +14,8 @@ from .resnet import (ResNetConfig, resnet18_config, resnet50_config,
                      resnet_init, resnet_apply)
 from .dcgan import (DCGANConfig, dcgan_init, generator_apply,
                     discriminator_apply)
+from .moe_transformer import (MoETransformerConfig, moe_transformer_init,
+                              moe_transformer_apply, moe_transformer_loss)
 
 __all__ = [
     "TransformerConfig", "transformer_init", "transformer_apply",
@@ -21,4 +23,6 @@ __all__ = [
     "ResNetConfig", "resnet18_config", "resnet50_config", "resnet_init",
     "resnet_apply",
     "DCGANConfig", "dcgan_init", "generator_apply", "discriminator_apply",
+    "MoETransformerConfig", "moe_transformer_init", "moe_transformer_apply",
+    "moe_transformer_loss",
 ]

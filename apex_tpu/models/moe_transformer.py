@@ -1,0 +1,144 @@
+"""Mixture-of-Experts transformer — the model-zoo vehicle for expert
+parallelism (``apex_tpu.parallel.expert``).
+
+No reference counterpart (the reference ships no MoE anywhere); this is
+the switch-transformer-style encoder: pre-LN attention + pre-LN MoE FFN
+with top-1 routing and a load-balancing aux loss.  Layers are a python
+loop (not scan) so per-layer expert weights can carry an explicit
+expert-shard axis for ``shard_map`` ep runs; under plain jit/pjit it runs
+single-device MoE (axis_name=None).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..normalization import fused_layer_norm_affine
+from ..contrib.multihead_attn.functional import attention_core
+from ..parallel.expert import MoELayer, moe_ffn
+
+
+@dataclasses.dataclass(frozen=True)
+class MoETransformerConfig:
+    vocab_size: int = 8192
+    max_len: int = 128
+    num_layers: int = 2
+    d_model: int = 256
+    num_heads: int = 4
+    d_ff: int = 512
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+    causal: bool = False      # BERT-style bidirectional, like TransformerConfig
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self):
+        assert self.d_model % self.num_heads == 0
+        return self.d_model // self.num_heads
+
+
+def _dense(key, shape, scale=0.02):
+    return scale * jax.random.normal(key, shape, jnp.float32)
+
+
+def moe_transformer_init(key, cfg: MoETransformerConfig,
+                         n_expert_shards: int = 1):
+    """Params pytree; expert weights have shape (E/n_shards, ...) per the
+    ep sharding convention (shard them with P('expert') on the leading
+    dim)."""
+    D, F = cfg.d_model, cfg.d_ff
+    moe = MoELayer(d_model=D, d_ff=F, num_experts=cfg.num_experts,
+                   n_shards=n_expert_shards,
+                   capacity_factor=cfg.capacity_factor)
+    key, k_tok, k_pos = jax.random.split(key, 3)
+    params = {
+        "embed": {"tok": _dense(k_tok, (cfg.vocab_size, D)),
+                  "pos": _dense(k_pos, (cfg.max_len, D))},
+        "layers": [],
+        "head_ln_g": jnp.ones((D,), jnp.float32),
+        "head_ln_b": jnp.zeros((D,), jnp.float32),
+    }
+    for _ in range(cfg.num_layers):
+        key, k1, k2, k3 = jax.random.split(key, 4)
+        params["layers"].append({
+            "ln1_g": jnp.ones((D,), jnp.float32),
+            "ln1_b": jnp.zeros((D,), jnp.float32),
+            "qkv": _dense(k1, (D, 3 * D)),
+            "out": _dense(k2, (D, D)),
+            "ln2_g": jnp.ones((D,), jnp.float32),
+            "ln2_b": jnp.zeros((D,), jnp.float32),
+            # expert FFN params come from MoELayer.init — ONE source of
+            # truth for the (router, w_in, w_out) convention
+            **moe.init(k3),
+        })
+    return params
+
+
+def moe_transformer_apply(params, tokens, cfg: MoETransformerConfig, *,
+                          expert_axis: Optional[str] = None):
+    """tokens (B, S) -> (logits (B, S, V) f32, aux_loss scalar).
+
+    ``expert_axis``: mesh axis name for expert parallelism (call inside
+    shard_map with expert weights sharded on their leading dim); None =
+    single-device MoE.
+    """
+    B, S = tokens.shape
+    dt = cfg.dtype
+    emb = params["embed"]
+    x = (emb["tok"].astype(dt)[tokens]
+         + emb["pos"].astype(dt)[None, :S, :])
+    aux_total = jnp.zeros((), jnp.float32)
+    H = cfg.num_heads
+
+    for lyr in params["layers"]:
+        h = fused_layer_norm_affine(x, lyr["ln1_g"].astype(dt),
+                                    lyr["ln1_b"].astype(dt), (cfg.d_model,))
+        qkv = (h.reshape(B * S, -1) @ lyr["qkv"].astype(dt)).reshape(
+            B, S, 3, cfg.d_model)
+        scale = cfg.head_dim ** -0.5
+        # (B, S, D) -> (B, H, S, hd) per q/k/v
+        q = qkv[:, :, 0].reshape(B, S, H, -1).transpose(0, 2, 1, 3) * scale
+        k = qkv[:, :, 1].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
+        v = qkv[:, :, 2].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
+        ctx = attention_core(q, k, v, jnp.zeros((1, S, S), jnp.float32),
+                             causal=cfg.causal)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B * S, cfg.d_model)
+        x = x + (ctx.astype(dt) @ lyr["out"].astype(dt)).reshape(x.shape)
+
+        h = fused_layer_norm_affine(x, lyr["ln2_g"].astype(dt),
+                                    lyr["ln2_b"].astype(dt), (cfg.d_model,))
+        moe_out, aux = moe_ffn(h.reshape(B * S, cfg.d_model), lyr["router"],
+                               lyr["w_in"], lyr["w_out"],
+                               axis_name=expert_axis,
+                               capacity_factor=cfg.capacity_factor)
+        x = x + moe_out.reshape(x.shape).astype(dt)
+        aux_total = aux_total + aux
+
+    x = fused_layer_norm_affine(x, params["head_ln_g"].astype(dt),
+                                params["head_ln_b"].astype(dt),
+                                (cfg.d_model,))
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                        emb["tok"].astype(jnp.float32))
+    return logits, aux_total
+
+
+def moe_transformer_loss(params, batch, cfg: MoETransformerConfig, *,
+                         expert_axis: Optional[str] = None):
+    """Masked-LM cross entropy + aux_weight * load-balancing loss."""
+    from ..contrib.xentropy import softmax_xentropy_loss
+    logits, aux = moe_transformer_apply(params, batch["tokens"], cfg,
+                                        expert_axis=expert_axis)
+    B, S, V = logits.shape
+    nll = softmax_xentropy_loss(logits.reshape(B * S, V),
+                                batch["targets"].reshape(B * S),
+                                0.0, -1).reshape(B, S)
+    w = batch.get("weights")
+    if w is None:
+        mlm = nll.mean()
+    else:
+        mlm = (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
+    return mlm + cfg.aux_weight * aux

@@ -32,7 +32,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu import amp
 from apex_tpu.models import (TransformerConfig, bert_large_config,
-                             transformer_init, transformer_loss)
+                             transformer_init, transformer_loss,
+                             MoETransformerConfig, moe_transformer_init,
+                             moe_transformer_loss)
 from apex_tpu.optimizers import FusedLAMB
 from apex_tpu.parallel import create_mesh, use_mesh
 from apex_tpu.utils.logging import AverageMeter, Throughput
@@ -54,6 +56,10 @@ def parse_args(argv=None):
     p.add_argument("--distributed", action="store_true")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO sharded optimizer (DistributedFusedLAMB)")
+    p.add_argument("--moe", type=int, default=0, metavar="E",
+                   help="use a Mixture-of-Experts FFN with E experts "
+                        "(single-device MoE here; sharded ep lives in "
+                        "tests/dryrun via shard_map)")
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args(argv)
@@ -70,8 +76,11 @@ def synthetic_mlm(rng, batch, seq, vocab):
 
 def run_standard(args, cfg, mesh):
     """amp O5 + FusedLAMB (flat fused engine) under pjit sharding."""
+    moe = isinstance(cfg, MoETransformerConfig)
+    init_fn = moe_transformer_init if moe else transformer_init
+    loss_impl = moe_transformer_loss if moe else transformer_loss
     params = jax.jit(
-        lambda: transformer_init(jax.random.PRNGKey(args.seed), cfg))()
+        lambda: init_fn(jax.random.PRNGKey(args.seed), cfg))()
     opt = FusedLAMB(lr=args.lr, weight_decay=0.01, max_grad_norm=1.0,
                     impl="fused")
     state = amp.initialize(params, opt, opt_level=args.opt_level,
@@ -81,7 +90,7 @@ def run_standard(args, cfg, mesh):
     @jax.jit
     def train_step(state, batch):
         def loss_fn(p):
-            loss = transformer_loss(p, batch, cfg)
+            loss = loss_impl(p, batch, cfg)
             return amp.scale_loss(loss, state), loss
         g, loss = jax.grad(loss_fn, has_aux=True)(state.model_params)
         return amp.amp_step(state, g), loss
@@ -156,8 +165,16 @@ def run_zero(args, cfg, mesh):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.moe and (args.bert_large or args.zero):
+        raise SystemExit("--moe combines with the standard path only")
     if args.bert_large:
         cfg = bert_large_config(dtype=jnp.bfloat16)
+    elif args.moe:
+        cfg = MoETransformerConfig(
+            vocab_size=args.vocab, max_len=args.seq_len,
+            num_layers=args.layers, d_model=args.d_model,
+            num_heads=args.heads, d_ff=4 * args.d_model,
+            num_experts=args.moe, dtype=jnp.bfloat16)
     else:
         cfg = TransformerConfig(
             vocab_size=args.vocab, max_len=args.seq_len,

@@ -7,8 +7,11 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+import functools
+
 from apex_tpu import amp
-from apex_tpu.optimizers import FusedAdam, FusedLAMB
+from apex_tpu.optimizers import (FusedAdam, FusedLAMB, FusedSGD,
+                                 FusedNovoGrad, FusedAdagrad)
 
 
 def _params():
@@ -26,7 +29,11 @@ def _grads(i, scale):
 
 
 @pytest.mark.parametrize("opt_level", ["O2", "O5"])
-@pytest.mark.parametrize("opt_cls", [FusedAdam, FusedLAMB])
+@pytest.mark.parametrize("opt_cls", [
+    FusedAdam, FusedLAMB,
+    functools.partial(FusedSGD, momentum=0.9),
+    FusedNovoGrad, FusedAdagrad,
+], ids=["adam", "lamb", "sgd", "novograd", "adagrad"])
 def test_fused_flat_amp_matches_xla_amp(opt_level, opt_cls):
     params = _params()
     st_x = amp.initialize(params, opt_cls(lr=1e-2, weight_decay=0.01),

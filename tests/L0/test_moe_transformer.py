@@ -1,0 +1,88 @@
+"""MoE transformer model tests: trains, aux loss live, and the ep-sharded
+apply matches the single-device model exactly."""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.models import (MoETransformerConfig, moe_transformer_init,
+                             moe_transformer_apply, moe_transformer_loss)
+
+CFG = MoETransformerConfig(vocab_size=256, max_len=32, num_layers=2,
+                           d_model=32, num_heads=4, d_ff=64, num_experts=8,
+                           capacity_factor=8.0)
+
+
+def test_shapes_and_training():
+    params = moe_transformer_init(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    logits, aux = moe_transformer_apply(params, tokens, CFG)
+    assert logits.shape == (2, 16, 256) and logits.dtype == jnp.float32
+    assert float(aux) > 0        # load-balancing loss is live
+
+    batch = {"tokens": tokens, "targets": tokens}
+    step = jax.jit(jax.value_and_grad(
+        lambda p: moe_transformer_loss(p, batch, CFG)))
+    p = params
+    l0 = None
+    for _ in range(15):
+        loss, g = step(p)
+        p = jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0      # descends (memorizing 32 tokens)
+
+
+def test_expert_sharded_matches_single_device():
+    """Sharded-expert apply inside shard_map == the single-device model
+    (tokens replicated: same routing decisions, no capacity difference
+    since per-device token count equals the global count here)."""
+    n = 8
+    mesh = Mesh(np.array(jax.devices()[:n]), ("expert",))
+    params = moe_transformer_init(jax.random.PRNGKey(2), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, 256)
+    ref, aux_ref = moe_transformer_apply(params, tokens, CFG)
+
+    def shard_experts(params):
+        def spec(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else ""
+            return P("expert") if name in ("w_in", "w_out") else P()
+        return jax.tree_util.tree_map_with_path(spec, params)
+
+    pspec = shard_experts(params)
+
+    # check_vma=False: with replicated tokens the outputs ARE identical on
+    # every device, but that equality flows through the expert all_to_all
+    # and cannot be statically proven by the vma system
+    try:
+        smap = functools.partial(shard_map, mesh=mesh,
+                                 in_specs=(pspec, P()),
+                                 out_specs=(P(), P()), check_vma=False)
+    except TypeError:  # older jax
+        smap = functools.partial(shard_map, mesh=mesh,
+                                 in_specs=(pspec, P()),
+                                 out_specs=(P(), P()), check_rep=False)
+
+    @jax.jit
+    @smap
+    def sharded(params, tokens):
+        logits, aux = moe_transformer_apply(params, tokens, CFG,
+                                            expert_axis="expert")
+        return logits, jax.lax.pmean(aux, "expert")
+
+    out, aux = sharded(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-4)
+
+
+def test_shard_validation():
+    with pytest.raises(ValueError):
+        moe_transformer_init(jax.random.PRNGKey(0), CFG, n_expert_shards=3)
