@@ -74,6 +74,10 @@ def parse_args(argv=None):
                    help="documented no-op under pjit: global-batch BN stats "
                         "are already synchronized when the batch is sharded")
     p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--validate", type=int, default=0, metavar="N",
+                   help="run an N-step eval pass after training (synthetic "
+                        "val set; prints eval Speed + Prec@1/@5 like the "
+                        "reference validate())")
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--resume", default=None, help="checkpoint to resume from")
@@ -128,6 +132,41 @@ def npz_batches(data_dir, batch, steps):
                 n += 1
                 if n >= steps:
                     return
+
+
+def validate(args, cfg, state, bn_state, mesh, batch_sharding):
+    """Eval pass (reference validate(), main_amp.py:457 Speed/Prec prints):
+    train=False BN (running stats), top-1/top-5 on synthetic data."""
+    @jax.jit
+    def eval_step(state, bn_state, images, labels):
+        logits, _ = resnet_apply(state.model_params, bn_state, images, cfg,
+                                 train=False)
+        logits = logits.astype(jnp.float32)
+        top1 = jnp.mean(
+            (jnp.argmax(logits, axis=1) == labels).astype(jnp.float32))
+        top5_idx = jax.lax.top_k(logits, 5)[1]
+        top5 = jnp.mean(jnp.any(top5_idx == labels[:, None],
+                                axis=1).astype(jnp.float32))
+        return top1, top5
+
+    m1, m5, speed = AverageMeter(), AverageMeter(), AverageMeter()
+    t0 = time.perf_counter()
+    with use_mesh(mesh):
+        for step, (np_images, np_labels) in enumerate(
+                synthetic_batches(args.batch_size, args.seed + 1,
+                                  args.validate)):
+            images = jax.device_put(np_images, batch_sharding)
+            labels = jax.device_put(np_labels, batch_sharding)
+            top1, top5 = eval_step(state, bn_state, images, labels)
+            m1.update(float(top1))          # host sync = timing boundary
+            m5.update(float(top5))
+            dt = time.perf_counter() - t0
+            if step > 0:                    # skip compile step
+                speed.update(args.batch_size / dt)
+            t0 = time.perf_counter()
+    print(f"=> eval: Speed {speed.avg:.1f} img/s  "
+          f"Prec@1 {m1.avg:.3f} Prec@5 {m5.avg:.3f}")
+    return m1.avg
 
 
 def main(argv=None):
@@ -235,6 +274,9 @@ def main(argv=None):
                       f"Prec@1 {top1.val:.3f}", flush=True)
                 t0 = time.perf_counter()
                 window = 0
+
+    if args.validate:
+        validate(args, cfg, state, bn_state, mesh, batch_sharding)
 
     if args.save:
         checkpoint.save(args.save, step=end_step, model=state.model_params,
