@@ -228,6 +228,28 @@ def bench_multi_tensor(results, on_tpu):
                               jnp.all(jnp.isfinite(2.0 * a - b)))),
         flat, flat2)
 
+    # the Pallas Adam kernel vs the XLA-on-flat math the optimizers use —
+    # keeps the PERF_NOTES §2 retirement decision measured every round
+    from apex_tpu.multi_tensor_apply import kernels as K
+    m = jnp.zeros_like(flat)
+    v = jnp.zeros_like(flat)
+    scalars = jnp.asarray([[1e-3, 0.9, 0.999, 1e-8, 0.01, 1.1, 1.2, 1.0]],
+                          jnp.float32)
+
+    def xla_adam(g, p, m, v):
+        m2 = 0.9 * m + 0.1 * g
+        v2 = 0.999 * v + 0.001 * g * g
+        u = (m2 * 1.1) / (jnp.sqrt(v2 * 1.2) + 1e-8) + 0.01 * p
+        return p - 1e-3 * u, m2, v2
+
+    results["adam_update"] = ab(
+        "adam_update",
+        jax.jit(lambda g, p, m, v: K.fused_adam_flat(g, p, m, v, scalars)),
+        jax.jit(xla_adam), flat, flat2, m, v)
+    results["adam_update"]["note"] = ("pallas kernel retained for the "
+                                      "sharded ZeRO path; optimizers use "
+                                      "the XLA math (PERF_NOTES §2)")
+
 
 def run(budget_left=lambda: 1e9):
     on_tpu = jax.default_backend() == "tpu"
