@@ -109,6 +109,13 @@ def trace(log_dir: str):
         stop_trace()
 
 
+def cost_report(fn, *args, **kwargs):
+    """FLOPs/bytes/roofline report for a compiled step — see
+    :mod:`apex_tpu.pyprof.prof` (the reference's ``prof`` mode analog)."""
+    from . import prof as _prof
+    return _prof.cost_report(fn, *args, **kwargs)
+
+
 def server(port: int = 9999):
     """Live-attach profiling server (``jax.profiler.start_server``) — the
     'nvprof attach' analog; connect from TensorBoard's profile tab."""

@@ -1,0 +1,173 @@
+"""``pyprof.parse`` analog — turn a captured profiler trace into per-op records.
+
+The reference's ``apex/pyprof/parse`` (``nvvp.py``, ``db.py``, ``kernel.py``)
+reads nvprof's SQLite export and emits one record per GPU kernel (name,
+duration, correlation to the NVTX marker stack).  The TPU-side capture is a
+``jax.profiler`` trace directory (written by :func:`apex_tpu.pyprof.trace`);
+each run dir contains a Chrome-format ``*.trace.json.gz`` whose complete
+spans (``ph == "X"``) cover python frames, XLA runtime threads, and — on
+real TPUs — per-HLO-op device timelines.  This module parses that file and
+aggregates per-op *self time* (duration minus time attributed to nested
+child spans), the analog of per-kernel GPU time:
+
+    python -m apex_tpu.pyprof.parse /tmp/trace_dir --top 20
+
+or programmatically::
+
+    from apex_tpu.pyprof import parse
+    events = parse.load("/tmp/trace_dir")
+    table  = parse.op_table(events)          # device/XLA ops only
+    print(parse.format_table(table))
+
+By default python host frames (thread name ``python``) are excluded so the
+table shows compute the way ``pyprof.prof`` shows kernels; pass
+``include_python=True`` for the host-side view (the traceMarker analog).
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Any
+
+# Runtime bookkeeping spans that would pollute an op table (not compute).
+_NOISE_PREFIXES = (
+    "ThreadpoolListener", "ThunkExecutor", "end: ", "Thread ",
+    "process_", "thread_",
+)
+
+
+def _latest_trace_file(logdir: str) -> str:
+    """Newest ``*.trace.json.gz`` under ``logdir`` (any host, newest run)."""
+    pats = [os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz"),
+            os.path.join(logdir, "*.trace.json.gz")]
+    hits: list[str] = []
+    for p in pats:
+        hits.extend(glob.glob(p))
+    if not hits:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {logdir!r} — capture one with "
+            "apex_tpu.pyprof.trace(logdir)")
+    return max(hits, key=os.path.getmtime)
+
+
+def load(logdir: str) -> list[dict[str, Any]]:
+    """Read the newest trace in ``logdir``; returns complete-span events,
+    each annotated with its process/thread display names."""
+    path = _latest_trace_file(logdir)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    raw = data.get("traceEvents", [])
+    pname: dict[Any, str] = {}
+    tname: dict[tuple, str] = {}
+    for e in raw:
+        if e.get("ph") == "M":
+            if e.get("name") == "process_name":
+                pname[e.get("pid")] = e["args"]["name"]
+            elif e.get("name") == "thread_name":
+                tname[(e.get("pid"), e.get("tid"))] = e["args"]["name"]
+    out = []
+    for e in raw:
+        if e.get("ph") != "X":
+            continue
+        out.append({
+            "name": e.get("name", "?"),
+            "ts": float(e.get("ts", 0.0)),
+            "dur": float(e.get("dur", 0.0)),
+            "pid": e.get("pid"),
+            "tid": e.get("tid"),
+            "process": pname.get(e.get("pid"), str(e.get("pid"))),
+            "thread": tname.get((e.get("pid"), e.get("tid")),
+                                str(e.get("tid"))),
+            "args": e.get("args", {}),
+        })
+    return out
+
+
+def _self_times(events: list[dict]) -> None:
+    """Attribute self time in place: ``self_us = dur - sum(child durs)``.
+
+    Spans within one (pid, tid) timeline nest by time containment (the
+    Chrome trace contract); a sweep with an open-span stack attributes each
+    span's duration to itself minus its direct children.
+    """
+    by_thread: dict[tuple, list[dict]] = {}
+    for e in events:
+        by_thread.setdefault((e["pid"], e["tid"]), []).append(e)
+    for evs in by_thread.values():
+        # parents first: earlier start, then longer duration
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: list[dict] = []
+        for e in evs:
+            e["self_us"] = e["dur"]
+            while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"]:
+                stack.pop()
+            if stack:   # e is a direct child of stack[-1]
+                stack[-1]["self_us"] -= e["dur"]
+            stack.append(e)
+
+
+def op_table(events: list[dict], include_python: bool = False,
+             include_noise: bool = False) -> list[dict]:
+    """Aggregate per-op-name records: count / total / self / avg / pct.
+
+    Mirrors the reference's kernel table (one row per kernel name with
+    summed durations); ``pct`` is the share of summed self time.
+    """
+    _self_times(events)
+    rows: dict[str, dict] = {}
+    for e in events:
+        if not include_python and e["thread"] == "python":
+            continue
+        if not include_noise and e["name"].startswith(_NOISE_PREFIXES):
+            continue
+        r = rows.setdefault(e["name"], {
+            "name": e["name"], "count": 0, "total_us": 0.0, "self_us": 0.0})
+        r["count"] += 1
+        r["total_us"] += e["dur"]
+        r["self_us"] += max(e["self_us"], 0.0)
+    table = sorted(rows.values(), key=lambda r: -r["self_us"])
+    total_self = sum(r["self_us"] for r in table) or 1.0
+    for r in table:
+        r["avg_us"] = r["total_us"] / r["count"]
+        r["pct"] = 100.0 * r["self_us"] / total_self
+    return table
+
+
+def format_table(table: list[dict], top: int = 20) -> str:
+    head = f"{'op':<48} {'count':>6} {'self ms':>9} {'avg us':>9} {'%':>6}"
+    lines = [head, "-" * len(head)]
+    for r in table[:top]:
+        name = r["name"] if len(r["name"]) <= 48 else r["name"][:45] + "..."
+        lines.append(f"{name:<48} {r['count']:>6} "
+                     f"{r['self_us'] / 1e3:>9.3f} {r['avg_us']:>9.1f} "
+                     f"{r['pct']:>6.1f}")
+    if len(table) > top:
+        rest = sum(r["self_us"] for r in table[top:])
+        lines.append(f"{'... ' + str(len(table) - top) + ' more':<48} "
+                     f"{'':>6} {rest / 1e3:>9.3f}")
+    return "\n".join(lines)
+
+
+def _main():   # pragma: no cover - exercised via CLI
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("logdir", help="trace dir written by pyprof.trace()")
+    p.add_argument("--top", type=int, default=20)
+    p.add_argument("--python", action="store_true",
+                   help="include python host frames (traceMarker analog)")
+    p.add_argument("--csv", action="store_true")
+    args = p.parse_args()
+    table = op_table(load(args.logdir), include_python=args.python)
+    if args.csv:
+        print("name,count,total_us,self_us,avg_us,pct")
+        for r in table:
+            print(f"\"{r['name']}\",{r['count']},{r['total_us']:.3f},"
+                  f"{r['self_us']:.3f},{r['avg_us']:.3f},{r['pct']:.2f}")
+    else:
+        print(format_table(table, top=args.top))
+
+
+if __name__ == "__main__":   # pragma: no cover
+    _main()

@@ -1,0 +1,147 @@
+"""pyprof shim tests — annotate API + the prof (cost-analysis) mode.
+
+Reference analog: ``tests/L0/run_pyprof_nvtx`` / ``run_pyprof_data`` —
+the profiler's API surface is unit-tested without a GPU profiler attached
+(SURVEY §4).  Here: annotate works inside and outside jit, and
+``prof.cost_report`` returns a sane FLOPs/bytes roofline report for a
+known workload.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import pyprof
+from apex_tpu.pyprof import prof
+
+
+def test_init_and_annotate_outside_jit(capsys):
+    pyprof.init()
+    assert pyprof.is_initialized()
+    out = capsys.readouterr().out
+    assert "jax.profiler" in out
+    with pyprof.annotate("region", step=3):
+        x = jnp.ones((4,)) * 2
+    assert float(x.sum()) == 8.0
+
+
+def test_annotate_inside_jit_names_scope():
+    @jax.jit
+    def f(x):
+        with pyprof.annotate("hot_matmul"):
+            return x @ x
+
+    x = jnp.ones((8, 8))
+    # the named scope must appear in the op metadata of the lowered module
+    # (plain as_text() strips location info; debug_info keeps it)
+    hlo = jax.jit(lambda x: f(x)).lower(x).as_text(debug_info=True)
+    assert "hot_matmul" in hlo
+    assert float(f(x)[0, 0]) == 8.0
+
+
+def test_annotate_function_decorator():
+    @pyprof.annotate_function(name="wrapped")
+    def g(x):
+        return x + 1
+
+    assert float(g(jnp.float32(1.0))) == 2.0
+
+
+def test_cost_report_matmul_flops():
+    n = 64
+
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((n, n), jnp.float32)
+    rep = prof.cost_report(f, a, a)
+    assert rep["platform"] == jax.devices()[0].platform
+    # an n^3 matmul is 2*n^3 FLOPs; cost models may fold constants but
+    # must land within 2x of the analytic count
+    analytic = 2 * n ** 3
+    assert analytic / 2 <= rep["flops"] <= analytic * 2, rep["flops"]
+    assert rep["bytes_accessed"] > 0
+    assert rep["arithmetic_intensity"] > 0
+    assert rep["projected_ms"] > 0
+    text = prof.format_report(rep)
+    assert "flops" in text and "roofline" in text
+
+
+def test_cost_report_scales_with_problem_size():
+    def f(a, b):
+        return a @ b
+
+    small = prof.cost_report(f, jnp.ones((32, 32)), jnp.ones((32, 32)))
+    big = prof.cost_report(f, jnp.ones((128, 128)), jnp.ones((128, 128)))
+    # 4x dim => 64x flops
+    assert big["flops"] > 10 * small["flops"]
+
+
+def test_measured_vs_projected_runs():
+    def f(a):
+        return jnp.sum(a * 2.0)
+
+    rep = prof.measured_vs_projected(f, jnp.ones((256, 256)), iters=3)
+    assert rep["measured_ms"] > 0
+    assert "utilisation" in rep
+
+
+def test_trace_capture(tmp_path):
+    d = str(tmp_path / "trace")
+    try:
+        with pyprof.trace(d):
+            jnp.ones((16,)).sum().block_until_ready()
+    except Exception as e:   # profiler unavailable in sandboxed CI
+        pytest.skip(f"profiler capture unavailable: {e}")
+    import os
+    found = [f for _, _, fs in os.walk(d) for f in fs]
+    assert found, "trace produced no files"
+
+
+# ---- parse (trace -> per-op table) -----------------------------------------
+
+def _fake_events():
+    # one XLA thread: fusion(10..110us) containing dot(20..80us);
+    # python thread span must be excluded by default
+    return [
+        {"name": "fusion.1", "ts": 10.0, "dur": 100.0, "pid": 1, "tid": 2,
+         "process": "/device:TPU:0", "thread": "XLA Op", "args": {}},
+        {"name": "dot.3", "ts": 20.0, "dur": 60.0, "pid": 1, "tid": 2,
+         "process": "/device:TPU:0", "thread": "XLA Op", "args": {}},
+        {"name": "$main.py:1 step", "ts": 0.0, "dur": 500.0, "pid": 1,
+         "tid": 9, "process": "/host:CPU", "thread": "python", "args": {}},
+    ]
+
+
+def test_parse_self_time_nesting():
+    from apex_tpu.pyprof import parse
+    table = parse.op_table(_fake_events())
+    by = {r["name"]: r for r in table}
+    assert "$main.py:1 step" not in by          # python excluded by default
+    assert by["dot.3"]["self_us"] == 60.0
+    assert by["fusion.1"]["self_us"] == 40.0    # 100 - 60 child
+    assert abs(sum(r["pct"] for r in table) - 100.0) < 1e-6
+    txt = parse.format_table(table)
+    assert "dot.3" in txt
+
+    withpy = {r["name"]: r for r in parse.op_table(
+        _fake_events(), include_python=True)}
+    assert "$main.py:1 step" in withpy
+
+
+def test_parse_real_capture(tmp_path):
+    from apex_tpu.pyprof import parse
+    d = str(tmp_path / "tr")
+    try:
+        with pyprof.trace(d):
+            for _ in range(2):
+                (jnp.ones((128, 128)) @ jnp.ones((128, 128))
+                 ).block_until_ready()
+    except Exception as e:
+        pytest.skip(f"profiler capture unavailable: {e}")
+    events = parse.load(d)
+    assert events, "trace parsed to zero events"
+    table = parse.op_table(events)
+    assert table, "no non-python ops in trace"
+    # the matmul must show up on an XLA/runtime thread
+    assert any("dot" in r["name"] for r in table), \
+        [r["name"] for r in table[:10]]

@@ -55,7 +55,9 @@ def _init_params():
     return params, bn_state
 
 
-def _apply(params, bn_state, x, compute_dtype):
+def _apply(params, bn_state, x, compute_dtype, axis_name=()):
+    """The swept workload; ``axis_name`` lets the distributed variant
+    (test_cross_product_distributed.py) reduce BN stats over the mesh."""
     def conv(x, w):
         return jax.lax.conv_general_dilated(
             x, w.astype(x.dtype), (1, 1), "SAME",
@@ -63,7 +65,8 @@ def _apply(params, bn_state, x, compute_dtype):
 
     def bn(x, p, s, name, ns):
         out, m, v = sync_batch_norm(x, p["scale"], p["bn_bias"], s["mean"],
-                                    s["var"], axis_name=(), training=True,
+                                    s["var"], axis_name=axis_name,
+                                    training=True,
                                     channel_last=True, fuse_relu=True)
         ns[name] = {"mean": m, "var": v}
         return out
