@@ -73,7 +73,8 @@ def initialize(params, optimizer=None, opt_level="O1", *,
                cast_model_type=None, patch_functions=None,
                keep_batchnorm_fp32=None, master_weights=None,
                loss_scale=None, min_loss_scale=1.0,
-               max_loss_scale=2.0 ** 24) -> AmpState:
+               max_loss_scale=2.0 ** 24,
+               allow_incoming_model_not_fp32=False) -> AmpState:
     """Opt-level driven setup (``frontend.py:258-425``).
 
     params: fp32 model param pytree.  optimizer: an apex_tpu fused optimizer
@@ -94,6 +95,23 @@ def initialize(params, optimizer=None, opt_level="O1", *,
             setattr(props, name, val)
     if verbosity:
         print(f"apex_tpu.amp: opt_level {opt_level} -> {props}")
+
+    # incoming params must be fp32 unless explicitly allowed
+    # (check_params_fp32, _initialize.py:79-116 gated at :170-171 by
+    # _amp_state.allow_incoming_model_not_fp32)
+    if not allow_incoming_model_not_fp32:
+        offending = []
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+            dt = getattr(leaf, "dtype", None) or jnp.result_type(leaf)
+            if jnp.issubdtype(dt, jnp.floating) and dt != jnp.float32:
+                offending.append(jax.tree_util.keystr(path))
+        if offending:
+            raise RuntimeError(
+                "Found param(s) that are not fp32: "
+                f"{offending[:8]}{'...' if len(offending) > 8 else ''}. "
+                "amp.initialize expects an fp32 model (it applies the "
+                "opt_level's cast itself); pass "
+                "allow_incoming_model_not_fp32=True if this is intended.")
 
     # model cast (O2/O3/O5 path; _initialize.py:176-182)
     model_params = params

@@ -1,0 +1,22 @@
+"""Input pipeline: native prefetching batch assembly.
+
+The reference keeps batch assembly off the training thread with a CUDA-side
+``data_prefetcher`` (examples/imagenet/main_amp.py) and DALI pipelines.  The
+TPU-native analog (csrc/prefetch.cpp) assembles batches on GIL-free C++
+worker threads over a ring of host buffers; the consumer overlaps
+``jax.device_put`` (async dispatch) of batch N with the workers filling
+N+1..N+depth.
+
+    from apex_tpu.data import NativeLoader, ArraySource, SyntheticSource
+
+    src = SyntheticSource(shape=(224, 224, 3), n_classes=1000)
+    for x, y in NativeLoader(src, batch_size=128, steps=100):
+        state = train_step(state, x, y)
+
+Degrades to a Python-thread fallback when no C++ toolchain is available
+(same API, same ring/overlap structure, GIL-bound fills).
+"""
+from .loader import ArraySource, NativeLoader, SyntheticSource, native_available
+
+__all__ = ["ArraySource", "NativeLoader", "SyntheticSource",
+           "native_available"]

@@ -1,0 +1,273 @@
+"""ctypes bindings + iterator for the native prefetch engine
+(csrc/prefetch.cpp) — the reference ``data_prefetcher``/DALI-stage analog.
+
+Contract:
+  * ``ArraySource``: samples gathered from a caller-owned contiguous array
+    (typically ``np.memmap``) at a seeded per-epoch shuffle; batches arrive
+    in deterministic order for any worker count.
+  * ``SyntheticSource``: C++-generated uniform data/labels (the examples'
+    synthetic-ImageNet mode) — batch assembly costs zero Python time.
+  * The loader yields DEVICE arrays: each host buffer is handed to
+    ``jax.device_put`` and released back to the ring immediately after the
+    transfer is dispatched, so workers refill it while the step runs.
+"""
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc", "prefetch.cpp")
+
+_lib = None
+_lib_tried = False
+
+
+def _build_dirs():
+    yield os.path.join(os.path.dirname(_SRC), "_build")
+    yield os.path.join(tempfile.gettempdir(), "apex_tpu_build")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_SRC):
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    for d in _build_dirs():
+        so = os.path.join(d, f"libapex_tpu_prefetch_{tag}.so")
+        if not os.path.exists(so):
+            try:
+                os.makedirs(d, exist_ok=True)
+                tmp = so + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", "-o", tmp, _SRC],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            except Exception:
+                continue
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            continue
+        lib.pf_create.restype = ctypes.c_void_p
+        lib.pf_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_uint64]
+        lib.pf_acquire.restype = ctypes.c_int32
+        lib.pf_acquire.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.pf_release.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.pf_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+    return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _put_checking_stop(q, item, stop) -> bool:
+    """put() that wakes up to honor `stop` — a producer blocked on a full
+    queue must not outlive an abandoned consumer (it would pin the data
+    source for the process lifetime)."""
+    import queue as _q
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except _q.Full:
+            continue
+    return False
+
+
+@dataclasses.dataclass
+class SyntheticSource:
+    """Uniform [-1, 1) fp32 samples + uniform labels, generated natively."""
+    shape: Tuple[int, ...]
+    n_classes: int = 1000
+
+    @property
+    def sample_bytes(self) -> int:
+        return int(np.prod(self.shape)) * 4
+
+
+@dataclasses.dataclass
+class ArraySource:
+    """Gather rows of a contiguous fp32 array (e.g. ``np.memmap``).
+
+    data: (N, *shape) float32, C-contiguous.  labels: (N,) int32.
+    """
+    data: np.ndarray
+    labels: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        # A memmap must already be fp32 C-contiguous: converting would
+        # silently materialize the whole dataset in RAM (4x on-disk for the
+        # common uint8 layout), defeating the no-load contract — fail fast.
+        if isinstance(self.data, np.memmap) and (
+                self.data.dtype != np.float32
+                or not self.data.flags["C_CONTIGUOUS"]):
+            raise ValueError(
+                "ArraySource memmap must be float32 and C-contiguous "
+                f"(got {self.data.dtype}); re-export the dataset rather "
+                "than loading it into RAM here.")
+        self.data = np.ascontiguousarray(self.data, dtype=np.float32)
+        if self.labels is not None:
+            if isinstance(self.labels, np.memmap) and \
+                    self.labels.dtype != np.int32:
+                raise ValueError("ArraySource labels memmap must be int32 "
+                                 f"(got {self.labels.dtype}).")
+            self.labels = np.ascontiguousarray(self.labels, dtype=np.int32)
+            assert self.labels.shape == (self.data.shape[0],)
+
+    @property
+    def shape(self):
+        return self.data.shape[1:]
+
+    @property
+    def sample_bytes(self) -> int:
+        return int(np.prod(self.shape)) * 4
+
+
+class NativeLoader:
+    """Iterator over prefetched (x, y) batches, device-put on dequeue.
+
+    depth: ring size (reference data_prefetcher double-buffers; default 3
+    keeps one extra batch in flight).  threads: C++ fill workers.
+    device_put: set False to receive numpy copies instead of device arrays
+    (e.g. when the consumer shards the batch itself).
+    """
+
+    def __init__(self, source, batch_size: int, steps: int, *,
+                 depth: int = 3, threads: int = 2, seed: int = 0,
+                 device_put: bool = True):
+        self.source = source
+        self.batch_size = int(batch_size)
+        self.steps = int(steps)
+        self.depth = int(depth)
+        self.threads = int(threads)
+        self.seed = int(seed)
+        self.device_put = device_put
+        self._shape = (self.batch_size,) + tuple(source.shape)
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        lib = _load()
+        if lib is None:
+            yield from self._iter_python()
+            return
+        synthetic = isinstance(self.source, SyntheticSource)
+        if synthetic:
+            base, labels, n_samples, n_classes = None, None, 1, \
+                self.source.n_classes
+        else:
+            base = self.source.data.ctypes.data_as(ctypes.c_char_p)
+            labels = (self.source.labels.ctypes.data_as(ctypes.c_void_p)
+                      if self.source.labels is not None else None)
+            n_samples = self.source.data.shape[0]
+            n_classes = 1
+        h = lib.pf_create(base, labels, n_samples,
+                          self.source.sample_bytes, self.batch_size,
+                          n_classes, self.depth, self.threads, self.seed)
+        if not h:
+            yield from self._iter_python()
+            return
+        try:
+            import jax
+            xp = ctypes.c_void_p()
+            yp = ctypes.c_void_p()
+            tk = ctypes.c_int64()
+            for _ in range(self.steps):
+                slot = lib.pf_acquire(h, ctypes.byref(xp), ctypes.byref(yp),
+                                      ctypes.byref(tk))
+                if slot < 0:
+                    break
+                n = int(np.prod(self._shape))
+                x = np.ctypeslib.as_array(
+                    ctypes.cast(xp, ctypes.POINTER(ctypes.c_float)),
+                    shape=(n,)).reshape(self._shape)
+                y = np.ctypeslib.as_array(
+                    ctypes.cast(yp, ctypes.POINTER(ctypes.c_int32)),
+                    shape=(self.batch_size,))
+                # Copy out of the slot before releasing it: jax.device_put
+                # may alias host memory (zero-copy on the CPU backend) or
+                # read it asynchronously, and a worker refills the slot the
+                # moment it is released.
+                xc, yc = x.copy(), y.copy()
+                lib.pf_release(h, slot)
+                if self.device_put:
+                    yield jax.device_put(xc), jax.device_put(yc)
+                else:
+                    yield xc, yc
+        finally:
+            lib.pf_destroy(h)
+
+    # -- GIL-bound fallback (same ring/overlap structure) ------------------
+    def _iter_python(self):
+        import queue as _q
+        import threading
+
+        q: "_q.Queue" = _q.Queue(maxsize=self.depth)
+        synthetic = isinstance(self.source, SyntheticSource)
+        stop = threading.Event()
+
+        def producer():
+            rng = np.random.RandomState(self.seed & 0x7fffffff)
+            n = (1 if synthetic else self.source.data.shape[0])
+            order = None
+            for t in range(self.steps):
+                if stop.is_set():
+                    return
+                if synthetic:
+                    x = rng.uniform(-1, 1, self._shape).astype(np.float32)
+                    y = rng.randint(0, self.source.n_classes,
+                                    self.batch_size).astype(np.int32)
+                else:
+                    bpe = max(1, n // self.batch_size)
+                    if t % bpe == 0:
+                        order = rng.permutation(n)
+                    i0 = (t % bpe) * self.batch_size
+                    idx = order[[(i0 + i) % n
+                                 for i in range(self.batch_size)]]
+                    x = self.source.data[idx]
+                    y = (self.source.labels[idx]
+                         if self.source.labels is not None
+                         else np.zeros(self.batch_size, np.int32))
+                if not _put_checking_stop(q, (x, y), stop):
+                    return
+            _put_checking_stop(q, None, stop)
+
+        th = threading.Thread(target=producer, daemon=True)
+        th.start()
+        try:
+            import jax
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                x, y = item
+                if self.device_put:
+                    yield jax.device_put(x), jax.device_put(y)
+                else:
+                    yield x, y
+        finally:
+            stop.set()

@@ -86,6 +86,13 @@ def parse_args(argv=None):
                    help="capture a profiler trace of steps 5-10 "
                         "(apex_tpu.pyprof)")
     p.add_argument("--prof-dir", default="/tmp/apex_tpu_trace")
+    p.add_argument("--loader", default="python",
+                   choices=["python", "native"],
+                   help="'native': assemble batches on the C++ prefetch "
+                        "engine (csrc/prefetch.cpp), the data_prefetcher/"
+                        "DALI-stage analog; works with synthetic data or "
+                        "with --data pointing at images.npy+labels.npy "
+                        "(memmapped)")
     return p.parse_args(argv)
 
 
@@ -115,6 +122,26 @@ def synthetic_batches(batch, seed, steps):
     for _ in range(steps):
         yield (rng.rand(batch, 224, 224, 3).astype(np.float32),
                rng.randint(0, 1000, size=(batch,)).astype(np.int32))
+
+
+def native_batches(args, batch, steps):
+    """Batches via the native prefetch engine (apex_tpu.data): C++ worker
+    threads assemble batches in a ring while the step runs; yields numpy so
+    the training loop's sharded device_put stays in charge of placement."""
+    from apex_tpu.data import ArraySource, NativeLoader, SyntheticSource
+    if args.data:
+        img = os.path.join(args.data, "images.npy")
+        lab = os.path.join(args.data, "labels.npy")
+        if not (os.path.exists(img) and os.path.exists(lab)):
+            raise FileNotFoundError(
+                f"--loader native with --data needs {img} + {lab} "
+                "(fp32 NHWC + int32; np.memmap-ed without loading)")
+        src = ArraySource(data=np.load(img, mmap_mode="r"),
+                          labels=np.load(lab, mmap_mode="r"))
+    else:
+        src = SyntheticSource(shape=(224, 224, 3), n_classes=1000)
+    return iter(NativeLoader(src, batch_size=batch, steps=steps,
+                             seed=args.seed, device_put=False))
 
 
 def npz_batches(data_dir, batch, steps):
@@ -236,9 +263,12 @@ def main(argv=None):
 
     total_steps = args.steps * args.epochs
     end_step = start_step + total_steps
-    batches = (npz_batches(args.data, args.batch_size, total_steps)
-               if args.data else
-               synthetic_batches(args.batch_size, args.seed, total_steps))
+    if args.loader == "native":
+        batches = native_batches(args, args.batch_size, total_steps)
+    elif args.data:
+        batches = npz_batches(args.data, args.batch_size, total_steps)
+    else:
+        batches = synthetic_batches(args.batch_size, args.seed, total_steps)
 
     losses, top1, speed = AverageMeter(), AverageMeter(), AverageMeter()
     prof = None

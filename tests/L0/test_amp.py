@@ -247,3 +247,21 @@ def test_legacy_optim_wrapper_multi_loss():
     assert opt.loss_scale(1) == s1 / 2 and opt.loss_scale(0) >= s0
     # attribute passthrough to the wrapped optimizer
     assert opt.lr == 0.1
+
+
+def test_incoming_params_must_be_fp32():
+    """check_params_fp32 analog (_initialize.py:79-116): non-fp32 incoming
+    params are rejected unless allow_incoming_model_not_fp32=True."""
+    import pytest
+    from apex_tpu.optimizers import FusedSGD
+    half = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+    with pytest.raises(RuntimeError, match="not fp32"):
+        amp.initialize(half, FusedSGD(lr=0.1), opt_level="O0", verbosity=0)
+    st = amp.initialize(half, FusedSGD(lr=0.1), opt_level="O0", verbosity=0,
+                        allow_incoming_model_not_fp32=True)
+    # O0's preset then applies its own cast_model_type=fp32, as in the
+    # reference (frontend.py O0 preset) — the hatch only skips the check
+    assert st.model_params["w"].dtype == jnp.float32
+    # integer leaves (e.g. step counters riding the tree) never trigger it
+    mixed = {"w": jnp.ones((4, 4), jnp.float32), "steps": jnp.zeros((), jnp.int32)}
+    amp.initialize(mixed, FusedSGD(lr=0.1), opt_level="O0", verbosity=0)

@@ -1,0 +1,101 @@
+"""Native prefetch engine tests (csrc/prefetch.cpp via apex_tpu.data).
+
+Oracle pattern: gather correctness is checked structurally (row content
+encodes the sample index, so every batch proves its own gather) rather than
+by predicting the shuffle; epochs must be exact permutations; the native
+path must be deterministic for any worker count (strict ticket ordering).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from apex_tpu.data import (ArraySource, NativeLoader, SyntheticSource,
+                           native_available)
+
+
+def _collect(loader):
+    return [(np.asarray(x), np.asarray(y)) for x, y in loader]
+
+
+def _indexed_source(n=64, d=8):
+    # row i filled with value i, label i: any gathered row self-identifies
+    data = np.repeat(np.arange(n, dtype=np.float32)[:, None], d, axis=1)
+    labels = np.arange(n, dtype=np.int32)
+    return ArraySource(data=data, labels=labels)
+
+
+@pytest.mark.parametrize("threads", [1, 3])
+def test_gather_epoch_is_permutation(threads):
+    n, d, b = 64, 8, 16
+    src = _indexed_source(n, d)
+    batches = _collect(NativeLoader(src, batch_size=b, steps=n // b,
+                                    threads=threads, seed=7))
+    seen = []
+    for x, y in batches:
+        assert x.shape == (b, d) and x.dtype == np.float32
+        assert y.shape == (b,) and y.dtype == np.int32
+        # gather correctness: every row's content equals its label
+        np.testing.assert_array_equal(x[:, 0].astype(np.int32), y)
+        np.testing.assert_array_equal(x, x[:, :1].repeat(d, axis=1))
+        seen.extend(y.tolist())
+    # one epoch = exactly one visit per sample
+    assert sorted(seen) == list(range(n))
+
+
+def test_second_epoch_reshuffles():
+    n, b = 64, 16
+    src = _indexed_source(n)
+    two_epochs = _collect(NativeLoader(src, batch_size=b,
+                                       steps=2 * (n // b), seed=3))
+    e1 = np.concatenate([y for _, y in two_epochs[: n // b]])
+    e2 = np.concatenate([y for _, y in two_epochs[n // b:]])
+    assert sorted(e1.tolist()) == sorted(e2.tolist()) == list(range(n))
+    assert not np.array_equal(e1, e2), "epoch order did not reshuffle"
+
+
+def test_deterministic_across_worker_counts():
+    if not native_available():
+        pytest.skip("no native toolchain")
+    src = _indexed_source(48, 4)
+    a = _collect(NativeLoader(src, batch_size=12, steps=8, threads=1, seed=5))
+    b = _collect(NativeLoader(src, batch_size=12, steps=8, threads=4, seed=5))
+    for (xa, ya), (xb, yb) in zip(a, b):
+        np.testing.assert_array_equal(ya, yb)
+        np.testing.assert_array_equal(xa, xb)
+
+
+def test_synthetic_batches():
+    src = SyntheticSource(shape=(4, 4, 3), n_classes=10)
+    batches = _collect(NativeLoader(src, batch_size=8, steps=3, seed=1))
+    assert len(batches) == 3
+    for x, y in batches:
+        assert x.shape == (8, 4, 4, 3) and x.dtype == np.float32
+        assert np.all((x >= -1.0) & (x < 1.0))
+        assert np.all((y >= 0) & (y < 10))
+    assert not np.array_equal(batches[0][0], batches[1][0])
+
+
+def test_device_put_yields_jax_arrays():
+    src = SyntheticSource(shape=(2,), n_classes=2)
+    for x, y in NativeLoader(src, batch_size=4, steps=1):
+        assert isinstance(x, jnp.ndarray) and isinstance(y, jnp.ndarray)
+
+
+def test_python_fallback_same_contract(monkeypatch):
+    from apex_tpu.data import loader as L
+    monkeypatch.setattr(L, "_load", lambda: None)
+    n, b = 32, 8
+    src = _indexed_source(n)
+    seen = []
+    for x, y in NativeLoader(src, batch_size=b, steps=n // b, seed=2):
+        np.testing.assert_array_equal(
+            np.asarray(x)[:, 0].astype(np.int32), np.asarray(y))
+        seen.extend(np.asarray(y).tolist())
+    assert sorted(seen) == list(range(n))
+
+
+def test_native_engine_compiles():
+    """The toolchain is baked into this image; the native path must be
+    genuinely exercised in CI, not silently skipped via the fallback."""
+    assert native_available()
