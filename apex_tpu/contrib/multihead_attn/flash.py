@@ -34,6 +34,56 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
+# Mosaic fails at compile time (or spills) when a step's blocks exceed VMEM
+# (~16 MiB/core on v4/v5e-class chips); budget half of it so the pipeline
+# can double-buffer.  Overridable for tuning on real hardware without code
+# edits: APEX_TPU_FLASH_BLOCK_Q / _K pin the default block sizes (explicit
+# caller-passed sizes always win), APEX_TPU_FLASH_VMEM_MB moves the budget.
+_VMEM_BUDGET_MB = 8.0
+
+
+def _clamp_blocks(bq, bk, D, esz, bias_per_q, bwd=False, sq=None, sk=None):
+    """Shrink (bq, bk) until the kernel's per-step VMEM estimate fits the
+    budget.  ``bq``/``bk`` None means "default, overridable by env", and
+    only those are budget-clamped; explicit values (an autotune sweep, a
+    user who measured) are taken as-is so what runs is what was asked for —
+    a config that genuinely exceeds VMEM then fails loudly at compile.
+    ``sq``/``sk`` (the actual sequence lengths) cap the blocks BEFORE
+    estimating, so short sequences aren't shrunk below what fits anyway.
+    ``bwd=True`` models the recompute-backward kernels' larger footprint
+    (extra do/lse/delta streams, dk+dv outputs, two f32 (bk, D) scratch
+    accumulators).  Alignment floors: bk multiple of 128 (lane dim of the
+    bias block), bq multiple of 8 (sublane)."""
+    import os
+    bq_pinned, bk_pinned = bq is not None, bk is not None
+    if bq is None:
+        bq = int(os.environ.get("APEX_TPU_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
+    if bk is None:
+        bk = int(os.environ.get("APEX_TPU_FLASH_BLOCK_K", DEFAULT_BLOCK_K))
+    if sq is not None:
+        bq = min(bq, max(8, -(-sq // 8) * 8))
+    if sk is not None:
+        bk = min(bk, max(128, -(-sk // 128) * 128))
+    budget = float(os.environ.get("APEX_TPU_FLASH_VMEM_MB",
+                                  _VMEM_BUDGET_MB)) * 2 ** 20
+
+    def estimate(bq, bk):
+        qkv_io = (bq * D + 2 * bk * D + bq * D) * esz   # q, k, v, out|dq
+        bias = (bq if bias_per_q else 1) * bk * 4
+        scratch = bq * (2 + D) * 4 + bq * 4
+        total = 2 * (qkv_io + bias) + scratch           # x2: double buffer
+        if bwd:
+            extra_io = bq * D * esz + 2 * bq * 4        # do, lse, delta
+            extra_io += 2 * bk * D * esz                # dk + dv outputs
+            total += 2 * extra_io + 2 * bk * D * 4      # + dkv accumulators
+        return total
+
+    while estimate(bq, bk) > budget and not bk_pinned and bk > 128:
+        bk //= 2
+    while estimate(bq, bk) > budget and not bq_pinned and bq > 8:
+        bq //= 2
+    return max(8, (bq // 8) * 8), max(128, (bk // 128) * 128)
+
 
 from ...utils.pallas import interpret_mode as _interpret
 
@@ -184,10 +234,13 @@ def _check_bias_layout(q, bias, heads):
 
 
 def _flash_fwd(q, k, v, bias, causal, dropout_rate, seed, heads,
-               bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K):
+               bq=None, bk=None):
     """q (BH, Sq, D), k/v (BH, Sk, D), bias (1|B, 1|Sq, Sk) f32.
     Returns out (BH, Sq, D), lse (BH, Sq, 1) f32."""
     _check_bias_layout(q, bias, heads)
+    bq, bk = _clamp_blocks(bq, bk, q.shape[-1], q.dtype.itemsize,
+                           bias_per_q=bias.shape[1] != 1,
+                           sq=q.shape[1], sk=k.shape[1])
     q, k, v, bias, _, orig_sq, _ = _pad_inputs(q, k, v, bias, bq=bq, bk=bk)
     BH, Sq, D = q.shape
     Sk = k.shape[1]
@@ -324,10 +377,13 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
 
 
 def _flash_bwd(q, k, v, bias, causal, dropout_rate, seed, heads, out, lse,
-               do, bq=DEFAULT_BLOCK_Q, bk=DEFAULT_BLOCK_K):
+               do, bq=None, bk=None):
     # delta_i = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                   # (BH, Sq, 1)
+    bq, bk = _clamp_blocks(bq, bk, q.shape[-1], q.dtype.itemsize,
+                           bias_per_q=bias.shape[1] != 1, bwd=True,
+                           sq=q.shape[1], sk=k.shape[1])
     q, k, v, bias, do, orig_sq, orig_sk = _pad_inputs(q, k, v, bias, do,
                                                       bq=bq, bk=bk)
     BH, Sq, D = q.shape

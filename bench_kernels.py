@@ -117,6 +117,42 @@ def bench_attention(results, on_tpu):
     results["flash_attn_fwdbwd"]["shape"] = f"B{B} H{H} S{S} D{D} causal"
 
 
+def bench_flash_autotune(results, on_tpu):
+    """Sweep flash block sizes on the chip; the winner is what a user pins
+    via APEX_TPU_FLASH_BLOCK_Q/_K (flash.py honors them at trace time).
+    Skipped on CPU — interpret-mode timings would pick nonsense."""
+    if not on_tpu:
+        results["flash_autotune"] = {"skipped": "cpu interpret mode"}
+        return
+    from apex_tpu.contrib.multihead_attn.flash import _flash_fwd
+
+    B, H, S, D = 8, 16, 1024, 64
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B * H, S, D), jnp.bfloat16) / np.sqrt(D)
+    k = jax.random.normal(key, (B * H, S, D), jnp.bfloat16)
+    v = jax.random.normal(key, (B * H, S, D), jnp.bfloat16)
+    bias = jnp.zeros((1, 1, S), jnp.float32)
+
+    sweep = {}
+    for bq, bk in ((128, 512), (256, 512), (256, 1024), (512, 512),
+                   (512, 1024)):
+        fn = jax.jit(functools.partial(
+            _flash_fwd, causal=True, dropout_rate=0.0, seed=0, heads=H,
+            bq=bq, bk=bk))
+        try:
+            sweep[f"{bq}x{bk}"] = round(slope_ms(
+                lambda q, k, v: fn(q, k, v, bias)[0], q, k, v), 3)
+        except Exception as err:       # a config may not compile at this D
+            sweep[f"{bq}x{bk}"] = f"failed: {repr(err)[:80]}"
+        gc.collect()
+    timed = {c: t for c, t in sweep.items() if isinstance(t, float)}
+    results["flash_autotune"] = {
+        "shape": f"B{B} H{H} S{S} D{D} causal fwd",
+        "sweep_ms": sweep,
+        "best": min(timed, key=timed.get) if timed else None,
+    }
+
+
 def bench_xentropy(results, on_tpu):
     from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
 
@@ -258,7 +294,7 @@ def run(budget_left=lambda: 1e9):
             'meaningful'})")
     results = {}
     for fn in (bench_attention, bench_xentropy, bench_layer_norm,
-               bench_mlp, bench_multi_tensor):
+               bench_mlp, bench_multi_tensor, bench_flash_autotune):
         if budget_left() < 40:
             _log(f"budget exhausted before {fn.__name__}")
             break

@@ -253,3 +253,18 @@ def test_deprecated_adam_max_grad_norm_clips():
             deprecated.FusedAdam(params, eps_inside_sqrt=True)
     with pytest.raises(NotImplementedError):
         opt.step(grads=big, grad_norms=[1.0])
+
+
+def test_testing_module_api():
+    """apex.testing analog: platform gates are importable public API."""
+    from apex_tpu import testing as T
+    assert not T.on_tpu()                    # suite runs on the CPU cluster
+    assert T.backends_initialized()
+
+    @T.skip_if_no_tpu
+    def needs_tpu():                          # pragma: no cover
+        raise AssertionError("must be skipped on CPU")
+
+    import pytest
+    with pytest.raises(pytest.skip.Exception):
+        needs_tpu()

@@ -249,3 +249,74 @@ def test_flash_fully_masked_rows_emit_zeros():
                                            h).sum())(q)
     assert np.all(np.isfinite(np.asarray(g)))
     np.testing.assert_array_equal(np.asarray(g), 0.0)
+
+
+def test_flash_block_clamp():
+    """VMEM-budget clamp: defaults fit an 8 MiB budget at common head dims;
+    a tiny budget forces aligned shrink on env-defaulted blocks; explicit
+    block sizes are never rewritten; the bwd footprint model is genuinely
+    stricter; and the kernel stays correct at clamped sizes."""
+    import os
+    from apex_tpu.contrib.multihead_attn import flash as F
+
+    # sanitize the WHOLE test against ambient tuning env (the knobs this
+    # feature documents would otherwise skew the assertions below)
+    old = dict(os.environ)
+    for k in ("APEX_TPU_FLASH_BLOCK_Q", "APEX_TPU_FLASH_BLOCK_K",
+              "APEX_TPU_FLASH_VMEM_MB"):
+        os.environ.pop(k, None)
+    try:
+        bq, bk = F._clamp_blocks(None, None, 64, 4, bias_per_q=False)
+        assert (bq, bk) == (512, 1024)      # default shapes fit the budget
+        bq, bk = F._clamp_blocks(None, None, 256, 4, bias_per_q=True)
+        assert bq % 8 == 0 and bk % 128 == 0 and (bq, bk) != (512, 1024)
+
+        # short sequences cap the blocks BEFORE the budget shrink: at
+        # D=512 f32 per-q bias the unconstrained clamp would go below 256,
+        # but (256, 256) already fits
+        assert F._clamp_blocks(None, None, 512, 4, True,
+                               sq=256, sk=256) == (256, 256)
+
+        # bwd model is strictly stricter: at bf16 D=64 under a 1.5 MiB
+        # budget the fwd estimate (~0.89 MiB) keeps (512, 1024) while the
+        # bwd estimate (~2.0 MiB) must shrink bk
+        os.environ["APEX_TPU_FLASH_VMEM_MB"] = "1.5"
+        fwd = F._clamp_blocks(None, None, 64, 2, bias_per_q=False)
+        bwd = F._clamp_blocks(None, None, 64, 2, bias_per_q=False, bwd=True)
+        assert fwd == (512, 1024), fwd
+        assert bwd[1] < 1024, bwd
+
+        os.environ["APEX_TPU_FLASH_VMEM_MB"] = "0.9"
+        bq, bk = F._clamp_blocks(None, None, 64, 4, bias_per_q=True)
+        assert bk == 128 and bq < 512 and bq % 8 == 0
+        # env pins fill the None defaults ...
+        os.environ["APEX_TPU_FLASH_BLOCK_Q"] = "64"
+        os.environ["APEX_TPU_FLASH_BLOCK_K"] = "256"
+        del os.environ["APEX_TPU_FLASH_VMEM_MB"]
+        assert F._clamp_blocks(None, None, 64, 4, False) == (64, 256)
+        # ... but never rewrite explicit block sizes (autotune sweeps),
+        # even under a budget that would otherwise shrink them
+        os.environ["APEX_TPU_FLASH_VMEM_MB"] = "0.25"
+        assert F._clamp_blocks(512, 512, 64, 4, False) == (512, 512)
+
+        # correctness under a forced tiny budget: blocks must come out
+        # strictly smaller than S so the clamped run is genuinely
+        # multi-block while the default run is single-block
+        os.environ.pop("APEX_TPU_FLASH_BLOCK_Q")
+        os.environ.pop("APEX_TPU_FLASH_BLOCK_K")
+        B, H, S, D = 1, 2, 512, 32
+        bq, bk = F._clamp_blocks(None, None, D, 4, bias_per_q=False)
+        assert bq < S and bk < S, (bq, bk)
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(k1, (B * H, S, D)) * 0.3
+        k = jax.random.normal(k2, (B * H, S, D)) * 0.3
+        v = jax.random.normal(k3, (B * H, S, D)) * 0.3
+        bias = jnp.zeros((1, 1, S), jnp.float32)
+        small = F.flash_attention(q, k, v, bias, causal=True, heads=H)
+        del os.environ["APEX_TPU_FLASH_VMEM_MB"]
+        big = F.flash_attention(q, k, v, bias, causal=True, heads=H)
+        np.testing.assert_allclose(np.asarray(small), np.asarray(big),
+                                   atol=2e-5)
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
