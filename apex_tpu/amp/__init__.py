@@ -32,6 +32,7 @@ from .frontend import (
     scale_loss,
     amp_step,
     amp_step_multi,
+    add_param_group,
     state_dict,
     load_state_dict,
     AmpState,

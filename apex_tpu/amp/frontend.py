@@ -263,6 +263,106 @@ def amp_step_multi(amp_state: AmpState, grads_and_ids, *, lr=None):
                               opt_state=new_opt_state)
 
 
+def add_param_group(amp_state: AmpState, new_params):
+    """Extend the trained parameter set mid-run — the ``add_param_group``
+    flow (``_process_optimizer.py:469-489`` patched method, tested by the
+    reference's ``tests/L0/run_amp/test_add_param_group.py``).
+
+    ``new_params``: fp32 pytree to merge into the model; both the existing
+    model tree and ``new_params`` must be dicts with disjoint top-level
+    keys (the functional analog of appending a param group).  Returns a new
+    AmpState over the merged tree in which
+
+      * existing leaves keep their master values, optimizer moments, and
+        step count (the schedule continues),
+      * new leaves get preset-consistent casts/masters and zero moments,
+      * scaler state carries over unchanged (a mid-run add must not reset
+        the dynamic loss scale).
+
+    Works for both impls; the flat fused engine repacks its buffers into
+    the merged layout once (a retrace + one-time copy, exactly like the
+    reference rebuilding its flat buffers)."""
+    props = amp_state.properties
+    opt = amp_state.optimizer
+    old32 = amp_state.params_for_eval()
+    if not (isinstance(old32, dict) and isinstance(new_params, dict)):
+        raise TypeError("add_param_group needs dict param pytrees "
+                        "(merge = new top-level keys)")
+    overlap = set(old32) & set(new_params)
+    if overlap:
+        raise ValueError(f"new param group re-uses existing keys: "
+                         f"{sorted(overlap)}")
+    merged32 = {**old32, **new_params}
+
+    fresh = initialize(
+        merged32, opt, opt_level=props.opt_level,
+        num_losses=len(amp_state.scalers), verbosity=0,
+        # forward EVERY stored property, not just the preset name — a user
+        # override like cast_model_type=bf16 on O2 must survive the re-init
+        cast_model_type=props.cast_model_type,
+        patch_functions=props.patch_functions,
+        keep_batchnorm_fp32=props.keep_batchnorm_fp32,
+        master_weights=props.master_weights,
+        loss_scale=props.loss_scale)
+
+    new_opt_state = fresh.opt_state
+    if amp_state.opt_state is not None and new_opt_state is not None:
+        if _is_fused_flat(opt):
+            new_opt_state = _migrate_flat_state(
+                amp_state, fresh, old32, merged32)
+        else:
+            merged_fields = {}
+            for field in new_opt_state._fields:
+                old_v = getattr(amp_state.opt_state, field)
+                fresh_v = getattr(new_opt_state, field)
+                if isinstance(old_v, dict) and isinstance(fresh_v, dict) \
+                        and set(old_v) <= set(fresh_v):
+                    merged_fields[field] = {**fresh_v, **old_v}
+                elif (hasattr(old_v, "shape") and hasattr(fresh_v, "shape")
+                      and old_v.shape == fresh_v.shape):
+                    merged_fields[field] = old_v        # count-style scalars
+                else:
+                    merged_fields[field] = fresh_v
+            new_opt_state = type(new_opt_state)(**merged_fields)
+
+    return fresh._replace(opt_state=new_opt_state,
+                          scalers=amp_state.scalers)
+
+
+def _migrate_flat_state(amp_state, fresh, old32, merged32):
+    """Scatter the old flat buffers (m/v/master/...) into the merged
+    layout: unflatten per the old packing plan, overlay onto the fresh
+    tree, re-flatten per the new plan.  Non-flat fields (count) carry."""
+    opt = amp_state.optimizer
+    old_fl = opt.flattener_for(jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32), old32))
+    old_total = old_fl.total
+    # capture old trees FIRST: flattener_for holds only one cached plan
+    old_trees = {}
+    for field in amp_state.opt_state._fields:
+        v = getattr(amp_state.opt_state, field)
+        if hasattr(v, "ndim") and getattr(v, "ndim", 0) == 1 \
+                and v.shape[0] == old_total:
+            old_trees[field] = old_fl.unflatten(v, dtype=jnp.float32)
+    new_fl = opt.flattener_for(jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32), merged32))
+    merged_fields = {}
+    for field in fresh.opt_state._fields:
+        fresh_v = getattr(fresh.opt_state, field)
+        old_v = getattr(amp_state.opt_state, field)
+        if field in old_trees and hasattr(fresh_v, "ndim") \
+                and fresh_v.ndim == 1 and fresh_v.shape[0] == new_fl.total:
+            fresh_tree = new_fl.unflatten(fresh_v, dtype=jnp.float32)
+            merged_fields[field] = new_fl.flatten(
+                {**fresh_tree, **old_trees[field]})
+        elif (hasattr(old_v, "shape") and hasattr(fresh_v, "shape")
+              and old_v.shape == fresh_v.shape):
+            merged_fields[field] = old_v                # count-style scalars
+        else:
+            merged_fields[field] = fresh_v
+    return type(fresh.opt_state)(**merged_fields)
+
+
 def master_params(amp_state: AmpState):
     """Iterate master (fp32) params — ``amp.master_params`` (_amp_state.py:58-68)."""
     if _flat_masters_active(amp_state):

@@ -55,7 +55,11 @@ def _clamp_blocks(bq, bk, D, esz, bias_per_q, bwd=False, sq=None, sk=None):
     accumulators).  Alignment floors: bk multiple of 128 (lane dim of the
     bias block), bq multiple of 8 (sublane)."""
     import os
-    bq_pinned, bk_pinned = bq is not None, bk is not None
+    # pinned = explicitly chosen, by argument OR by env (docs tell users to
+    # pin the autotune winner via env; a pin that got silently re-clamped
+    # would run a different kernel than the one measured)
+    bq_pinned = bq is not None or "APEX_TPU_FLASH_BLOCK_Q" in os.environ
+    bk_pinned = bk is not None or "APEX_TPU_FLASH_BLOCK_K" in os.environ
     if bq is None:
         bq = int(os.environ.get("APEX_TPU_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
     if bk is None:

@@ -1,0 +1,125 @@
+"""amp.add_param_group — the reference's
+``tests/L0/run_amp/test_add_param_group.py`` contract, functional form:
+extending the param set mid-run must preserve moments/masters/scaler for
+existing leaves, give new leaves clean preset-consistent state, and train
+both groups afterwards.  Covered for impl xla + fused across O2/O5.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam
+
+
+def _group_a():
+    return {"wa": 0.5 * jax.random.normal(jax.random.PRNGKey(0), (16, 8)),
+            "ba": jnp.zeros((8,))}
+
+
+def _group_b():
+    return {"wb": 0.5 * jax.random.normal(jax.random.PRNGKey(1), (8, 4))}
+
+
+def _loss_a(p, x):
+    return jnp.mean((x @ p["wa"] + p["ba"]) ** 2)
+
+
+def _loss_ab(p, x):
+    h = x @ p["wa"].astype(jnp.float32) + p["ba"].astype(jnp.float32)
+    return jnp.mean((h @ p["wb"].astype(jnp.float32)) ** 2)
+
+
+def _step(state, loss_fn, x):
+    def f(p):
+        p32 = jax.tree_util.tree_map(
+            lambda t: t.astype(jnp.float32)
+            if jnp.issubdtype(t.dtype, jnp.floating) else t, p)
+        return amp.scale_loss(loss_fn(p32, x), state)
+    loss, grads = jax.value_and_grad(f)(state.model_params)
+    return amp.amp_step(state, grads), loss
+
+
+@pytest.mark.parametrize("impl", ["xla", "fused"])
+@pytest.mark.parametrize("opt_level", ["O2", "O5"])
+def test_add_param_group_preserves_state(impl, opt_level):
+    x = jax.random.normal(jax.random.PRNGKey(2), (32, 16))
+    state = amp.initialize(_group_a(), FusedAdam(lr=1e-2, impl=impl),
+                           opt_level=opt_level, verbosity=0)
+    for _ in range(3):
+        state, _ = _step(state, _loss_a, x)
+    before32 = state.params_for_eval()
+    before_m = _moments_tree(state)
+    count_before = int(_count(state))
+
+    state2 = amp.add_param_group(state, _group_b())
+
+    # merged tree contains both groups; old fp32 values carried exactly
+    after32 = state2.params_for_eval()
+    assert set(after32) == {"wa", "ba", "wb"}
+    for k in ("wa", "ba"):
+        np.testing.assert_array_equal(np.asarray(before32[k]),
+                                      np.asarray(after32[k]))
+    np.testing.assert_allclose(np.asarray(after32["wb"]),
+                               np.asarray(_group_b()["wb"]), rtol=1e-6)
+
+    # old moments preserved, new zero, count continues
+    after_m = _moments_tree(state2)
+    for k in ("wa", "ba"):
+        np.testing.assert_allclose(np.asarray(before_m[k]),
+                                   np.asarray(after_m[k]), rtol=1e-6)
+    assert float(jnp.max(jnp.abs(after_m["wb"]))) == 0.0
+    assert int(_count(state2)) == count_before
+
+    # model-precision copies follow the preset
+    model_dt = {"O2": jnp.float16, "O5": jnp.bfloat16}[opt_level]
+    assert state2.model_params["wb"].dtype == model_dt
+
+    # training continues over BOTH groups (wb moves)
+    wb0 = np.asarray(state2.params_for_eval()["wb"])
+    for _ in range(3):
+        state2, loss = _step(state2, _loss_ab, x)
+    wb1 = np.asarray(state2.params_for_eval()["wb"])
+    assert np.isfinite(float(loss))
+    assert np.max(np.abs(wb1 - wb0)) > 0
+
+
+def test_add_param_group_keeps_scaler_state():
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 16))
+    state = amp.initialize(_group_a(), FusedAdam(lr=1e-2),
+                           opt_level="O2", verbosity=0)
+    # poison one step: dynamic scale halves from 65536
+    bad = jax.tree_util.tree_map(lambda g: jnp.full_like(g, jnp.inf),
+                                 state.master_params)
+    state = amp.amp_step(state, bad)
+    s = float(state.scalers[0].scale)
+    assert s == 65536.0 / 2
+    state2 = amp.add_param_group(state, _group_b())
+    assert float(state2.scalers[0].scale) == s
+
+
+def test_add_param_group_rejects_key_collisions():
+    state = amp.initialize(_group_a(), FusedAdam(lr=1e-2),
+                           opt_level="O0", verbosity=0)
+    with pytest.raises(ValueError, match="re-uses"):
+        amp.add_param_group(state, {"wa": jnp.zeros((2, 2))})
+
+
+# -- helpers ---------------------------------------------------------------
+
+def _count(state):
+    return state.opt_state.count
+
+
+def _moments_tree(state):
+    """First-moment (m) as an fp32 tree regardless of impl."""
+    opt_state = state.opt_state
+    m = opt_state.m
+    if hasattr(m, "ndim") and getattr(m, "ndim", 0) == 1:
+        fl = state.optimizer.flattener_for(jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32),
+            state.params_for_eval()))
+        return fl.unflatten(m, dtype=jnp.float32)
+    return m

@@ -294,10 +294,12 @@ def test_flash_block_clamp():
         os.environ["APEX_TPU_FLASH_BLOCK_K"] = "256"
         del os.environ["APEX_TPU_FLASH_VMEM_MB"]
         assert F._clamp_blocks(None, None, 64, 4, False) == (64, 256)
-        # ... but never rewrite explicit block sizes (autotune sweeps),
-        # even under a budget that would otherwise shrink them
+        # ... but never rewrite PINNED block sizes — explicit arguments
+        # (autotune sweeps) or env pins — even under a budget that would
+        # otherwise shrink them
         os.environ["APEX_TPU_FLASH_VMEM_MB"] = "0.25"
         assert F._clamp_blocks(512, 512, 64, 4, False) == (512, 512)
+        assert F._clamp_blocks(None, None, 64, 4, False) == (64, 256)
 
         # correctness under a forced tiny budget: blocks must come out
         # strictly smaller than S so the clamped run is genuinely
