@@ -42,6 +42,8 @@ class AmpState:
     opt_state: Any                  # optimizer state or None
     properties: Any = dataclasses.field(metadata=dict(static=True), default=None)
     optimizer: Any = dataclasses.field(metadata=dict(static=True), default=None)
+    cast_model_outputs: Any = dataclasses.field(metadata=dict(static=True),
+                                                default=None)
 
     def _replace(self, **kw):
         return dataclasses.replace(self, **kw)
@@ -57,6 +59,16 @@ class AmpState:
             return x
         args, _ = _pt.cast_inputs((x,), {}, dt)
         return args[0]
+
+    def cast_output(self, y):
+        """Apply the ``cast_model_outputs`` dtype (reference
+        ``_initialize.py:185-190``: the forward patch's output_caster) — a
+        no-op unless initialize() was given one."""
+        dt = self.cast_model_outputs
+        if dt in (None, False):
+            return y
+        args, _ = _pt.cast_inputs((y,), {}, dt)   # same float predicate as
+        return args[0]                            # cast_input (skips scalars)
 
     def params_for_eval(self):
         """fp32 view of params (the O2 state_dict hook, _initialize.py:133-142)."""
@@ -74,7 +86,8 @@ def initialize(params, optimizer=None, opt_level="O1", *,
                keep_batchnorm_fp32=None, master_weights=None,
                loss_scale=None, min_loss_scale=1.0,
                max_loss_scale=2.0 ** 24,
-               allow_incoming_model_not_fp32=False) -> AmpState:
+               allow_incoming_model_not_fp32=False,
+               cast_model_outputs=None) -> AmpState:
     """Opt-level driven setup (``frontend.py:258-425``).
 
     params: fp32 model param pytree.  optimizer: an apex_tpu fused optimizer
@@ -151,7 +164,8 @@ def initialize(params, optimizer=None, opt_level="O1", *,
 
     return AmpState(model_params=model_params, master_params=masters,
                     scalers=scalers, opt_state=opt_state, properties=props,
-                    optimizer=optimizer)
+                    optimizer=optimizer,
+                    cast_model_outputs=cast_model_outputs)
 
 
 def _is_fused_flat(optimizer) -> bool:
@@ -303,7 +317,8 @@ def add_param_group(amp_state: AmpState, new_params):
         patch_functions=props.patch_functions,
         keep_batchnorm_fp32=props.keep_batchnorm_fp32,
         master_weights=props.master_weights,
-        loss_scale=props.loss_scale)
+        loss_scale=props.loss_scale,
+        cast_model_outputs=amp_state.cast_model_outputs)
 
     new_opt_state = fresh.opt_state
     if amp_state.opt_state is not None and new_opt_state is not None:

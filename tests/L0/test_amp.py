@@ -265,3 +265,24 @@ def test_incoming_params_must_be_fp32():
     # integer leaves (e.g. step counters riding the tree) never trigger it
     mixed = {"w": jnp.ones((4, 4), jnp.float32), "steps": jnp.zeros((), jnp.int32)}
     amp.initialize(mixed, FusedSGD(lr=0.1), opt_level="O0", verbosity=0)
+
+
+def test_cast_model_outputs():
+    """cast_model_outputs kwarg (reference frontend.py:269, the forward
+    patch's output_caster _initialize.py:185-190): floating outputs cast,
+    non-floating untouched, default is a no-op; survives add_param_group."""
+    from apex_tpu.optimizers import FusedSGD
+    p = {"w": jnp.ones((4, 4))}
+    st = amp.initialize(p, FusedSGD(lr=0.1), opt_level="O5", verbosity=0,
+                        cast_model_outputs=jnp.float32)
+    out = {"logits": jnp.ones((2,), jnp.bfloat16),
+           "ids": jnp.zeros((2,), jnp.int32), "aux_loss": 0.5}
+    cast = st.cast_output(out)
+    assert cast["logits"].dtype == jnp.float32
+    assert cast["ids"].dtype == jnp.int32
+    assert cast["aux_loss"] == 0.5          # python scalars pass through
+    st2 = amp.add_param_group(st, {"w2": jnp.ones((2, 2))})
+    assert st2.cast_model_outputs == jnp.float32
+    # default: no-op
+    st3 = amp.initialize(p, FusedSGD(lr=0.1), opt_level="O5", verbosity=0)
+    assert st3.cast_output(out)["logits"].dtype == jnp.bfloat16

@@ -94,3 +94,93 @@ def test_scale_kernel_jits():
     out, flag = f(fl.flatten(tree))
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(fl.flatten(tree)) * 2.0, rtol=1e-6)
+
+
+# ---- property tests: randomized pytrees through the flat engine ----------
+
+def _random_tree(rng, depth=0):
+    """Random nested dict/list pytree with adversarial leaf shapes: scalars,
+    LANE-unaligned vectors, odd matrices, mixed fp32/bf16/fp16."""
+    dtypes = [jnp.float32, jnp.bfloat16, jnp.float16]
+    shapes = [(), (1,), (7,), (127,), (128,), (129,), (3, 5), (2, 3, 4),
+              (64, 33)]
+
+    def leaf():
+        shape = shapes[rng.randint(len(shapes))]
+        dt = dtypes[rng.randint(len(dtypes))]
+        return jnp.asarray(rng.randn(*shape) if shape else rng.randn(),
+                           dtype=dt)
+
+    n = rng.randint(2, 5)
+    if depth >= 2:
+        return {f"l{i}": leaf() for i in range(n)}
+    out = {}
+    for i in range(n):
+        r = rng.rand()
+        if r < 0.4:
+            out[f"k{i}"] = leaf()
+        elif r < 0.6:
+            out[f"s{i}"] = [leaf() for _ in range(rng.randint(1, 4))]
+        else:
+            out[f"d{i}"] = _random_tree(rng, depth + 1)
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_flatten_roundtrip_random_trees(seed):
+    rng = np.random.RandomState(seed)
+    tree = _random_tree(rng)
+    fl = TreeFlattener(tree)
+    flat = fl.flatten(tree)
+    assert flat.shape[0] % 128 == 0
+    back = fl.unflatten(flat)
+    for a, b in zip(jax.tree_util.tree_leaves(tree),
+                    jax.tree_util.tree_leaves(back)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_per_tensor_reductions_random_trees(seed):
+    rng = np.random.RandomState(100 + seed)
+    tree = _random_tree(rng)
+    fl = TreeFlattener(tree)
+    flat = fl.flatten(tree)
+    leaves = [np.asarray(l, np.float32).ravel()
+              for l in jax.tree_util.tree_leaves(tree)]
+    # bf16/fp16 leaves quantize on pack: compare against the packed values
+    packed = [np.asarray(l.astype(fl.dtype), np.float32).ravel()
+              for l in jax.tree_util.tree_leaves(tree)]
+    want_sumsq = np.array([np.sum(p * p) for p in packed], np.float32)
+    got_sumsq = np.asarray(fl.per_tensor_sumsq(flat))
+    np.testing.assert_allclose(got_sumsq, want_sumsq, rtol=2e-5, atol=1e-6)
+    want_max = np.array([np.max(np.abs(p)) if p.size else 0.0
+                         for p in packed], np.float32)
+    np.testing.assert_allclose(np.asarray(fl.per_tensor_maxabs(flat)),
+                               want_max, rtol=1e-6)
+    assert len(leaves) == fl.num_leaves
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fused_matches_xla_on_random_trees(seed):
+    """FusedAdam impl parity on an adversarial pytree: nested structure,
+    unaligned shapes (all fp32 — the fused master is fp32 by contract)."""
+    from apex_tpu.optimizers import FusedAdam
+    rng = np.random.RandomState(200 + seed)
+    tree = jax.tree_util.tree_map(
+        lambda l: l.astype(jnp.float32), _random_tree(rng))
+    grads = jax.tree_util.tree_map(
+        lambda l: jnp.asarray(rng.randn(*l.shape), jnp.float32) * 0.1, tree)
+    outs = {}
+    for impl in ("xla", "fused"):
+        opt = FusedAdam(lr=1e-2, weight_decay=0.01, impl=impl)
+        state = opt.init(tree)
+        p = tree
+        for _ in range(3):
+            p, state = opt.step(state, grads, p)
+        outs[impl] = p
+    for a, b in zip(jax.tree_util.tree_leaves(outs["xla"]),
+                    jax.tree_util.tree_leaves(outs["fused"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-6, atol=2e-7)
