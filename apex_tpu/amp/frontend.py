@@ -54,21 +54,13 @@ class AmpState:
         return self.scalers[0].loss_scale
 
     def cast_input(self, x):
-        dt = self.properties.cast_model_type
-        if dt in (None, False):
-            return x
-        args, _ = _pt.cast_inputs((x,), {}, dt)
-        return args[0]
+        return _cast_floats(x, self.properties.cast_model_type)
 
     def cast_output(self, y):
         """Apply the ``cast_model_outputs`` dtype (reference
         ``_initialize.py:185-190``: the forward patch's output_caster) — a
         no-op unless initialize() was given one."""
-        dt = self.cast_model_outputs
-        if dt in (None, False):
-            return y
-        args, _ = _pt.cast_inputs((y,), {}, dt)   # same float predicate as
-        return args[0]                            # cast_input (skips scalars)
+        return _cast_floats(y, self.cast_model_outputs)
 
     def params_for_eval(self):
         """fp32 view of params (the O2 state_dict hook, _initialize.py:133-142)."""
@@ -78,6 +70,15 @@ class AmpState:
         return jax.tree_util.tree_map(
             lambda p: p.astype(jnp.float32)
             if jnp.issubdtype(p.dtype, jnp.floating) else p, src)
+
+
+def _cast_floats(tree, dt):
+    """Cast floating array leaves to ``dt`` (None/False = no-op); python
+    scalars and integer arrays pass through (_pt.cast_inputs predicate)."""
+    if dt in (None, False):
+        return tree
+    args, _ = _pt.cast_inputs((tree,), {}, dt)
+    return args[0]
 
 
 def initialize(params, optimizer=None, opt_level="O1", *,

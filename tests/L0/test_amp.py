@@ -271,7 +271,6 @@ def test_cast_model_outputs():
     """cast_model_outputs kwarg (reference frontend.py:269, the forward
     patch's output_caster _initialize.py:185-190): floating outputs cast,
     non-floating untouched, default is a no-op; survives add_param_group."""
-    from apex_tpu.optimizers import FusedSGD
     p = {"w": jnp.ones((4, 4))}
     st = amp.initialize(p, FusedSGD(lr=0.1), opt_level="O5", verbosity=0,
                         cast_model_outputs=jnp.float32)

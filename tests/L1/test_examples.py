@@ -69,3 +69,13 @@ def test_imagenet_example_native_loader(tmp_path):
                      "--steps", "3", "--print-freq", "3",
                      "--loader", "native", "--data", str(tmp_path)])
     assert speed >= 0
+
+
+def test_imagenet_example_distributed():
+    """--distributed + --sync-bn over the 8-device mesh (the DDP+SyncBN
+    BASELINE config shape), with the native loader feeding it."""
+    ex = _load("examples/imagenet/main_amp.py", "ex_imagenet_dist")
+    speed = ex.main(["--arch", "resnet18", "--batch-size", "16",
+                     "--steps", "2", "--print-freq", "2",
+                     "--distributed", "--sync-bn", "--loader", "native"])
+    assert speed >= 0
