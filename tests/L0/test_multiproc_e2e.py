@@ -1,0 +1,57 @@
+"""End-to-end multiproc launcher test — the analog of the reference's REAL
+multi-process distributed tests (``tests/distributed/`` runs 2 GPU
+processes via ``torch.distributed.launch``; here 2 CPU processes form a
+jax.distributed cluster over loopback).  Exercises, for real:
+``python -m apex_tpu.parallel.multiproc`` env bring-up → worker
+``initialize_distributed()`` → cross-process allgather + global-mesh psum
+(tests/L0/_mp_worker.py).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_two_process_cluster_psum():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    # merge into inherited XLA_FLAGS (rewrite only the device-count flag)
+    # rather than clobbering — ambient flags should reach the workers too
+    import re
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    flags = (flags + " --xla_force_host_platform_device_count=2").strip()
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=flags)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "apex_tpu.parallel.multiproc",
+             "--nnodes", "2", "--node_rank", str(rank),
+             "--coordinator", f"127.0.0.1:{port}",
+             os.path.join(ROOT, "tests", "L0", "_mp_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=300)[0])
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        # reap and collect partial output for the failure message
+        partial = [p.communicate()[0] for p in procs]
+        raise AssertionError(
+            "worker hang; partial outputs:\n"
+            + "\n---\n".join(o[-2000:] for o in partial if o))
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+        # 2 hosts x 2 devices, each device contributes i+1: psum = 10
+        assert f"MPOK rank={rank} world=2 psum=10" in out, out[-2000:]
