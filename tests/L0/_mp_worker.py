@@ -1,6 +1,19 @@
 """Worker for the multiproc e2e test: joins the 2-process cluster set up by
 ``python -m apex_tpu.parallel.multiproc`` env, runs a cross-process
 allgather + a global-mesh psum, prints a checkable line per rank."""
+import faulthandler
+import signal
+
+faulthandler.register(signal.SIGUSR1)   # kill -USR1 dumps stacks (debug)
+
+# Neutralize any ambient remote-TPU-tunnel plugin (e.g. a sitecustomize on
+# the inherited PYTHONPATH) BEFORE any backend can initialize: a wedged
+# tunnel otherwise hangs this worker at jax backend init, which presents
+# as a cluster-formation deadlock.  Same helper the test conftest uses.
+from apex_tpu.utils.platform import force_cpu
+
+force_cpu(2)
+
 import numpy as np
 
 from apex_tpu.parallel import initialize_distributed

@@ -1,12 +1,16 @@
-"""End-to-end multiproc launcher test — the analog of the reference's REAL
+"""End-to-end multiproc launcher tests — the analog of the reference's REAL
 multi-process distributed tests (``tests/distributed/`` runs 2 GPU
 processes via ``torch.distributed.launch``; here 2 CPU processes form a
-jax.distributed cluster over loopback).  Exercises, for real:
-``python -m apex_tpu.parallel.multiproc`` env bring-up → worker
-``initialize_distributed()`` → cross-process allgather + global-mesh psum
-(tests/L0/_mp_worker.py).
+jax.distributed cluster over loopback):
+
+- cluster psum: launcher env bring-up → ``initialize_distributed()`` →
+  cross-process allgather + global-mesh psum (``_mp_worker.py``);
+- amp_master_params: O2 + DDP training across process boundaries with
+  rank-consistency and master==half(model) checks (``_mp_amp_worker.py``,
+  mirroring ``tests/distributed/amp_master_params/compare.py``).
 """
 import os
+import re
 import socket
 import subprocess
 import sys
@@ -15,43 +19,86 @@ ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def test_two_process_cluster_psum():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+def _run_two_process(worker_filename, timeout=120, attempts=3):
+    """Launch ``worker_filename`` under the multiproc launcher on 2 ranks
+    (2 virtual devices each) over a fresh loopback coordinator port;
+    returns [(proc, output), ...] after asserting both exited cleanly.
 
-    # merge into inherited XLA_FLAGS (rewrite only the device-count flag)
-    # rather than clobbering — ambient flags should reach the workers too
-    import re
+    Cluster formation over loopback is occasionally racy (ephemeral-port
+    TOCTOU between picking the coordinator port and the workers binding
+    it; Gloo full-mesh connect with the previous cluster's sockets in
+    TIME_WAIT) — a wedged attempt is killed, reaped, and retried on a
+    fresh port rather than failing the suite."""
+    # merge into inherited env (rewrite only the device-count flag /
+    # prepend to PYTHONPATH) rather than clobbering — ambient settings
+    # should reach the workers too
     flags = os.environ.get("XLA_FLAGS", "")
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
     flags = (flags + " --xla_force_host_platform_device_count=2").strip()
-    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+    pythonpath = os.pathsep.join(
+        p for p in (ROOT, os.environ.get("PYTHONPATH", "")) if p)
+    env = dict(os.environ, PYTHONPATH=pythonpath, JAX_PLATFORMS="cpu",
                XLA_FLAGS=flags)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-m", "apex_tpu.parallel.multiproc",
-             "--nnodes", "2", "--node_rank", str(rank),
-             "--coordinator", f"127.0.0.1:{port}",
-             os.path.join(ROOT, "tests", "L0", "_mp_worker.py")],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True)
-        for rank in (0, 1)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            outs.append(p.communicate(timeout=300)[0])
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        # reap and collect partial output for the failure message
-        partial = [p.communicate()[0] for p in procs]
-        raise AssertionError(
-            "worker hang; partial outputs:\n"
-            + "\n---\n".join(o[-2000:] for o in partial if o))
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+
+    failures = []
+    for attempt in range(attempts):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "apex_tpu.parallel.multiproc",
+                 "--nnodes", "2", "--node_rank", str(rank),
+                 "--coordinator", f"127.0.0.1:{port}",
+                 os.path.join(ROOT, "tests", "L0", worker_filename)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            for rank in (0, 1)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                outs.append(p.communicate(timeout=timeout)[0])
+        except subprocess.TimeoutExpired:
+            # a rank that ALREADY exited nonzero is a deterministic crash
+            # (its peer blocks in cluster formation forever) — fail fast
+            # with that rank's output instead of burning the retries
+            crashed = [(r, p) for r, p in enumerate(procs)
+                       if p.poll() not in (None, 0)]
+            for p in procs:
+                p.kill()
+            # reap; keep partial output in case every attempt wedges
+            partial = [p.communicate()[0] for p in procs]
+            if crashed:
+                rank = crashed[0][0]
+                raise AssertionError(
+                    f"rank {rank} crashed (rc={crashed[0][1].returncode}):\n"
+                    f"{partial[rank][-2000:]}")
+            failures.append("\n---\n".join(o[-1000:] for o in partial if o))
+            continue
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+        return list(zip(procs, outs))
+    raise AssertionError(
+        f"cluster wedged on all {attempts} attempts; partial outputs:\n"
+        + "\n=====\n".join(failures))
+
+
+def test_two_process_cluster_psum():
+    results = _run_two_process("_mp_worker.py")
+    for rank, (_, out) in enumerate(results):
         # 2 hosts x 2 devices, each device contributes i+1: psum = 10
         assert f"MPOK rank={rank} world=2 psum=10" in out, out[-2000:]
+
+
+def test_two_process_amp_master_params():
+    """Workers assert rank-consistency and master==half(model); the parent
+    cross-checks the ranks' digests match."""
+    results = _run_two_process("_mp_amp_worker.py")
+    digests = []
+    for rank, (_, out) in enumerate(results):
+        m = re.search(rf"AMPOK rank={rank} digest=([0-9.]+)", out)
+        assert m, out[-2000:]
+        digests.append(m.group(1))
+    assert digests[0] == digests[1], digests
