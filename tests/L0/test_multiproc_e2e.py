@@ -56,32 +56,37 @@ def _run_two_process(worker_filename, timeout=120, attempts=3):
                 text=True)
             for rank in (0, 1)
         ]
-        outs = []
-        try:
-            for p in procs:
-                outs.append(p.communicate(timeout=timeout)[0])
-        except subprocess.TimeoutExpired:
-            # a rank that ALREADY exited nonzero is a deterministic crash
-            # (its peer blocks in cluster formation forever) — fail fast
-            # with that rank's output instead of burning the retries
-            crashed = [(r, p) for r, p in enumerate(procs)
-                       if p.poll() not in (None, 0)]
-            for p in procs:
+        # Poll rather than a blind blocking wait: a rank that exits nonzero
+        # leaves its peer blocked in cluster formation forever, and waiting
+        # the full timeout for that would burn ~timeout seconds per retry.
+        # Both a crashed rank (possibly the coordinator losing the
+        # ephemeral-port race) and a genuine wedge are retried on a fresh
+        # port, with outputs kept for the final failure message.
+        import time
+        deadline = time.monotonic() + timeout
+        abort = None
+        while time.monotonic() < deadline:
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                break
+            if any(rc not in (None, 0) for rc in rcs):
+                time.sleep(5)          # grace for the peer to notice
+                abort = "crash"
+                break
+            time.sleep(1)
+        else:
+            abort = "wedge"
+        for p in procs:
+            if p.poll() is None:
                 p.kill()
-            # reap; keep partial output in case every attempt wedges
-            partial = [p.communicate()[0] for p in procs]
-            if crashed:
-                rank = crashed[0][0]
-                raise AssertionError(
-                    f"rank {rank} crashed (rc={crashed[0][1].returncode}):\n"
-                    f"{partial[rank][-2000:]}")
-            failures.append("\n---\n".join(o[-1000:] for o in partial if o))
-            continue
-        for rank, (p, out) in enumerate(zip(procs, outs)):
-            assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
-        return list(zip(procs, outs))
+        outs = [p.communicate()[0] for p in procs]   # reap + collect
+        if abort is None and all(p.returncode == 0 for p in procs):
+            return list(zip(procs, outs))
+        failures.append(
+            f"[{abort or 'exit'} rcs={[p.returncode for p in procs]}]\n"
+            + "\n---\n".join(o[-1000:] for o in outs if o))
     raise AssertionError(
-        f"cluster wedged on all {attempts} attempts; partial outputs:\n"
+        f"cluster failed on all {attempts} attempts:\n"
         + "\n=====\n".join(failures))
 
 
