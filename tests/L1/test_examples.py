@@ -79,3 +79,17 @@ def test_imagenet_example_distributed():
                      "--steps", "2", "--print-freq", "2",
                      "--distributed", "--sync-bn", "--loader", "native"])
     assert speed >= 0
+
+
+def test_bert_example_zero_and_moe():
+    """The --zero (DistributedFusedLAMB shard_map) and --moe legs of the
+    BERT example run end to end on the mesh."""
+    ex = _load("examples/bert/pretrain.py", "ex_bert_flags")
+    loss = ex.main(["--steps", "2", "--batch-size", "8", "--seq-len", "32",
+                    "--d-model", "64", "--layers", "1", "--vocab", "256",
+                    "--print-freq", "2", "--zero"])
+    assert np.isfinite(loss)
+    loss = ex.main(["--steps", "2", "--batch-size", "8", "--seq-len", "32",
+                    "--d-model", "64", "--layers", "1", "--vocab", "256",
+                    "--print-freq", "2", "--moe", "4"])
+    assert np.isfinite(loss)
