@@ -165,3 +165,50 @@ def cpu_platform(n_devices: int | None = None):
             jax.clear_caches()
         except Exception:  # pragma: no cover
             pass
+
+
+def probe_ambient_backend(timeout: float = 75.0) -> bool:
+    """True iff a fresh process can bring up the ambient jax backend within
+    ``timeout`` — run as a killable SUBPROCESS because a wedged tunnel dial
+    blocks in C++ and cannot be interrupted in-process.  Single source for
+    the tunnel health probe (bench.py and driver entry points share it)."""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def ensure_live_backend(probe_timeout: float = 75.0) -> str:
+    """Best-effort guard against hanging on a wedged remote-TPU tunnel at
+    the first in-process jax op: if no backend is initialized yet and a
+    tunnel backend could be dialed, probe it via :func:`probe_ambient_backend`
+    and pin the CPU platform on failure.  Returns the platform now expected
+    to initialize ("cpu" after a fallback).
+
+    This removes the dominant failure mode (a persistently wedged tunnel)
+    but is NOT a hard guarantee: the in-process dial after a healthy probe
+    can still block if the single-client slot is lost in the probe-to-init
+    window.  Entry points that can run their whole workload in a
+    subprocess (bench.py) should keep doing that instead.
+    """
+    if backends_initialized():
+        return jax.default_backend()
+    # fast path: nothing hangable — CPU already pinned, or no tunnel
+    # backend registered at all
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu"
+    try:
+        from jax._src import xla_bridge as _xb
+        if "axon" not in getattr(_xb, "_backend_factories", {}):
+            return os.environ.get("JAX_PLATFORMS", "") or "ambient"
+    except Exception:
+        pass
+    if probe_ambient_backend(probe_timeout):
+        return os.environ.get("JAX_PLATFORMS", "") or "ambient"
+    force_cpu()
+    return "cpu"

@@ -364,20 +364,13 @@ def main():
     deadline = time.monotonic() + 360.0   # leave room for the CPU fallback
     attempt_errs = []
 
-    # cheap health probe first: a wedged tunnel hangs ANY client at backend
-    # init, so burning the full budget on the real bench tells us nothing a
-    # 75s probe doesn't
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(len(jax.devices()))"],
-            capture_output=True, text=True, timeout=75)
-        healthy = r.returncode == 0
-        if not healthy:
-            attempt_errs.append(f"probe rc={r.returncode}: "
-                                + (r.stderr or "")[-150:])
-    except subprocess.TimeoutExpired:
-        healthy = False
+    # cheap health probe first (shared helper — single source for tunnel
+    # handling): a wedged tunnel hangs ANY client at backend init, so
+    # burning the full budget on the real bench tells us nothing a 75s
+    # probe doesn't
+    from apex_tpu.utils.platform import probe_ambient_backend
+    healthy = probe_ambient_backend(75)
+    if not healthy:
         attempt_errs.append("probe timeout (tunnel wedged)")
     attempts = 2 if healthy else 0
 

@@ -317,13 +317,8 @@ def main():
     back to CPU interpret mode so a JSON line is always emitted."""
     import subprocess
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-            capture_output=True, text=True, timeout=75)
-        healthy = r.returncode == 0
-    except subprocess.TimeoutExpired:
-        healthy = False
+    from apex_tpu.utils.platform import probe_ambient_backend
+    healthy = probe_ambient_backend(75)
     err = ""
     if healthy:
         try:

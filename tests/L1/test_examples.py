@@ -82,8 +82,10 @@ def test_imagenet_example_distributed():
 
 
 def test_bert_example_zero_and_moe():
-    """The --zero (DistributedFusedLAMB shard_map) and --moe legs of the
-    BERT example run end to end on the mesh."""
+    """The --zero (DistributedFusedLAMB shard_map) leg runs on the mesh;
+    the --moe leg runs the MoE FFN single-device (pretrain.py keeps MoE
+    local unless sharded — the mesh-sharded MoE path is exercised by
+    dryrun_multichip leg 4 and test_expert_parallel)."""
     ex = _load("examples/bert/pretrain.py", "ex_bert_flags")
     loss = ex.main(["--steps", "2", "--batch-size", "8", "--seq-len", "32",
                     "--d-model", "64", "--layers", "1", "--vocab", "256",
