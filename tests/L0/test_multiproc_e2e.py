@@ -7,7 +7,9 @@ jax.distributed cluster over loopback):
   cross-process allgather + global-mesh psum (``_mp_worker.py``);
 - amp_master_params: O2 + DDP training across process boundaries with
   rank-consistency and master==half(model) checks (``_mp_amp_worker.py``,
-  mirroring ``tests/distributed/amp_master_params/compare.py``).
+  mirroring ``tests/distributed/amp_master_params/compare.py``);
+- ZeRO: DistributedFusedLAMB sharded over the global 2-host mesh — each
+  rank owns 1/4 of the flat optimizer state (``_mp_zero_worker.py``).
 """
 import os
 import re
@@ -104,6 +106,19 @@ def test_two_process_amp_master_params():
     digests = []
     for rank, (_, out) in enumerate(results):
         m = re.search(rf"AMPOK rank={rank} digest=([0-9.]+)", out)
+        assert m, out[-2000:]
+        digests.append(m.group(1))
+    assert digests[0] == digests[1], digests
+
+
+def test_two_process_zero_optimizer():
+    """ZeRO across a REAL process boundary: DistributedFusedLAMB sharded
+    over the global 2-host mesh (each rank owns 1/4 of the flat state);
+    updated params must agree across ranks."""
+    results = _run_two_process("_mp_zero_worker.py")
+    digests = []
+    for rank, (_, out) in enumerate(results):
+        m = re.search(rf"ZEROOK rank={rank} count=3 digest=([0-9.]+)", out)
         assert m, out[-2000:]
         digests.append(m.group(1))
     assert digests[0] == digests[1], digests
