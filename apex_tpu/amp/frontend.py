@@ -88,14 +88,43 @@ def initialize(params, optimizer=None, opt_level="O1", *,
                loss_scale=None, min_loss_scale=1.0,
                max_loss_scale=2.0 ** 24,
                allow_incoming_model_not_fp32=False,
-               cast_model_outputs=None) -> AmpState:
+               cast_model_outputs=None) -> "AmpState | list[AmpState]":
     """Opt-level driven setup (``frontend.py:258-425``).
 
     params: fp32 model param pytree.  optimizer: an apex_tpu fused optimizer
     (algorithm object) — its state is created against the *master* params.
     Overrides after the preset mirror the reference's kwarg override flow
     (frontend.py:401-419).
+
+    Passing matching LISTS for both ``params`` and ``optimizer`` returns a
+    list of independent AmpStates (the reference's lists-of-models API,
+    frontend.py:296-331).
     """
+    # list-of-models API shape (frontend.py:296-331: "If either the
+    # ``models`` or ``optimizers`` args were lists, the corresponding
+    # return value will also be a list"): one AmpState per model, paired
+    # with its optimizer by position.  Triggered ONLY when BOTH args are
+    # top-level lists/tuples — a list is a legal pytree for a single
+    # model (pipeline stages, interop param lists), so params alone is
+    # ambiguous; a matching list of optimizers is the unambiguous signal.
+    if isinstance(params, (list, tuple)) \
+            and isinstance(optimizer, (list, tuple)):
+        opts = list(optimizer)
+        if len(opts) != len(params):
+            raise ValueError(
+                f"{len(params)} models but {len(opts)} optimizers")
+        kw = dict(num_losses=num_losses, verbosity=verbosity,
+                  cast_model_type=cast_model_type,
+                  patch_functions=patch_functions,
+                  keep_batchnorm_fp32=keep_batchnorm_fp32,
+                  master_weights=master_weights, loss_scale=loss_scale,
+                  min_loss_scale=min_loss_scale,
+                  max_loss_scale=max_loss_scale,
+                  allow_incoming_model_not_fp32=allow_incoming_model_not_fp32,
+                  cast_model_outputs=cast_model_outputs)
+        return [initialize(p, o, opt_level, **kw)
+                for p, o in zip(params, opts)]
+
     if opt_level not in opt_levels:
         raise RuntimeError(f"Unexpected optimization level {opt_level}; "
                            "options are 'O0'..'O5'.")

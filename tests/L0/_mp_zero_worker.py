@@ -1,9 +1,10 @@
 """Worker for the 2-process ZeRO e2e test: DistributedFusedLAMB
 (impl='xla' — interpret-mode Pallas under a multi-process Gloo mesh is
 not the target; the fused impl is covered in-process and by the dryrun)
-sharded over the GLOBAL mesh spanning both processes.  Each rank holds
-1/4 of the optimizer state; updated params must be identical everywhere
-and must match the digest printed by the peer."""
+sharded over the GLOBAL mesh spanning both processes.  Each DEVICE holds
+1/4 of the optimizer state (each rank drives 2 devices, so holds 1/2);
+updated params must be identical everywhere and must match the digest
+printed by the peer."""
 import faulthandler
 import signal
 

@@ -285,3 +285,32 @@ def test_cast_model_outputs():
     # default: no-op
     st3 = amp.initialize(p, FusedSGD(lr=0.1), opt_level="O5", verbosity=0)
     assert st3.cast_output(out)["logits"].dtype == jnp.bfloat16
+
+
+def test_initialize_list_of_models():
+    """Reference list API (frontend.py:296-331 +
+    test_multiple_models_optimizers_losses.py): lists of models AND
+    optimizers return a list of independent AmpStates; list params with a
+    single optimizer stay a single-model pytree."""
+    mA = {"w": jnp.ones((4, 4))}
+    mB = {"w": jnp.ones((2, 2)), "b": jnp.zeros((2,))}
+    states = amp.initialize([mA, mB], [FusedAdam(lr=1e-3), FusedSGD(lr=0.1)],
+                            opt_level="O2", verbosity=0)
+    assert isinstance(states, list) and len(states) == 2
+    assert states[0].model_params["w"].dtype == jnp.float16
+    assert states[1].master_params["b"].dtype == jnp.float32
+    # independent scalers
+    bad = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, jnp.inf), states[0].master_params)
+    s0 = amp.amp_step(states[0], bad)
+    assert float(s0.scalers[0].loss_scale) == 2.0 ** 15
+    assert float(states[1].scalers[0].loss_scale) == 2.0 ** 16
+
+    with pytest.raises(ValueError, match="models but"):
+        amp.initialize([mA, mB], [FusedAdam(lr=1e-3)], opt_level="O2",
+                       verbosity=0)
+
+    # a list pytree with ONE optimizer is a single model
+    st = amp.initialize([{"w": jnp.ones((2, 2))}], FusedAdam(lr=1e-3),
+                        opt_level="O0", verbosity=0)
+    assert not isinstance(st, list)

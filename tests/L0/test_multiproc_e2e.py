@@ -9,7 +9,8 @@ jax.distributed cluster over loopback):
   rank-consistency and master==half(model) checks (``_mp_amp_worker.py``,
   mirroring ``tests/distributed/amp_master_params/compare.py``);
 - ZeRO: DistributedFusedLAMB sharded over the global 2-host mesh — each
-  rank owns 1/4 of the flat optimizer state (``_mp_zero_worker.py``).
+  of the 4 devices owns 1/4 of the flat optimizer state
+  (``_mp_zero_worker.py``).
 """
 import os
 import re
@@ -113,8 +114,8 @@ def test_two_process_amp_master_params():
 
 def test_two_process_zero_optimizer():
     """ZeRO across a REAL process boundary: DistributedFusedLAMB sharded
-    over the global 2-host mesh (each rank owns 1/4 of the flat state);
-    updated params must agree across ranks."""
+    over the global 2-host mesh (each of the 4 devices owns 1/4 of the
+    flat state); updated params must agree across ranks."""
     results = _run_two_process("_mp_zero_worker.py")
     digests = []
     for rank, (_, out) in enumerate(results):
