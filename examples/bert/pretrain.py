@@ -60,6 +60,10 @@ def parse_args(argv=None):
                    help="use a Mixture-of-Experts FFN with E experts "
                         "(single-device MoE here; sharded ep lives in "
                         "tests/dryrun via shard_map)")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each layer (recompute activations "
+                        "in backward) — O(1)-in-depth activation memory "
+                        "for long sequences / deep stacks")
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args(argv)
@@ -167,8 +171,10 @@ def main(argv=None):
     args = parse_args(argv)
     if args.moe and (args.bert_large or args.zero):
         raise SystemExit("--moe combines with the standard path only")
+    if args.moe and args.remat:
+        raise SystemExit("--remat is not wired for the MoE path")
     if args.bert_large:
-        cfg = bert_large_config(dtype=jnp.bfloat16)
+        cfg = bert_large_config(dtype=jnp.bfloat16, remat=args.remat)
     elif args.moe:
         cfg = MoETransformerConfig(
             vocab_size=args.vocab, max_len=args.seq_len,
@@ -180,7 +186,7 @@ def main(argv=None):
             vocab_size=args.vocab, max_len=args.seq_len,
             num_layers=args.layers, d_model=args.d_model,
             num_heads=args.heads, d_ff=4 * args.d_model,
-            dtype=jnp.bfloat16)
+            dtype=jnp.bfloat16, remat=args.remat)
     n_dev = len(jax.devices()) if (args.distributed or args.zero) else 1
     if args.batch_size % n_dev:
         raise ValueError(f"batch {args.batch_size} must divide {n_dev}")
