@@ -34,6 +34,8 @@ class MoETransformerConfig:
     aux_weight: float = 0.01
     causal: bool = False      # BERT-style bidirectional, like TransformerConfig
     dtype: Any = jnp.float32
+    remat: bool = False       # jax.checkpoint each layer (recompute
+                              # activations + the all_to_all in backward)
 
     @property
     def head_dim(self):
@@ -78,6 +80,36 @@ def moe_transformer_init(key, cfg: MoETransformerConfig,
     return params
 
 
+def _moe_layer(x, lyr, cfg: MoETransformerConfig, expert_axis):
+    """One pre-LN attention + MoE-FFN block; split out so remat can wrap
+    it (cfg/expert_axis are static for jax.checkpoint)."""
+    B, S, _ = x.shape
+    dt = cfg.dtype
+    H = cfg.num_heads
+    h = fused_layer_norm_affine(x, lyr["ln1_g"].astype(dt),
+                                lyr["ln1_b"].astype(dt), (cfg.d_model,))
+    qkv = (h.reshape(B * S, -1) @ lyr["qkv"].astype(dt)).reshape(
+        B, S, 3, cfg.d_model)
+    scale = cfg.head_dim ** -0.5
+    # (B, S, D) -> (B, H, S, hd) per q/k/v
+    q = qkv[:, :, 0].reshape(B, S, H, -1).transpose(0, 2, 1, 3) * scale
+    k = qkv[:, :, 1].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
+    v = qkv[:, :, 2].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
+    ctx = attention_core(q, k, v, jnp.zeros((1, S, S), jnp.float32),
+                         causal=cfg.causal)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B * S, cfg.d_model)
+    x = x + (ctx.astype(dt) @ lyr["out"].astype(dt)).reshape(x.shape)
+
+    h = fused_layer_norm_affine(x, lyr["ln2_g"].astype(dt),
+                                lyr["ln2_b"].astype(dt), (cfg.d_model,))
+    moe_out, aux = moe_ffn(h.reshape(B * S, cfg.d_model), lyr["router"],
+                           lyr["w_in"], lyr["w_out"],
+                           axis_name=expert_axis,
+                           capacity_factor=cfg.capacity_factor)
+    x = x + moe_out.reshape(x.shape).astype(dt)
+    return x, aux
+
+
 def moe_transformer_apply(params, tokens, cfg: MoETransformerConfig, *,
                           expert_axis: Optional[str] = None):
     """tokens (B, S) -> (logits (B, S, V) f32, aux_loss scalar).
@@ -92,30 +124,16 @@ def moe_transformer_apply(params, tokens, cfg: MoETransformerConfig, *,
     x = (emb["tok"].astype(dt)[tokens]
          + emb["pos"].astype(dt)[None, :S, :])
     aux_total = jnp.zeros((), jnp.float32)
-    H = cfg.num_heads
 
+    layer = _moe_layer
+    if cfg.remat:
+        # recompute the layer (attention + routed FFN, including the
+        # all_to_all when expert-parallel) in the backward pass;
+        # prevent_cse=False — the python loop bodies are already distinct
+        layer = jax.checkpoint(_moe_layer, prevent_cse=False,
+                               static_argnums=(2, 3))
     for lyr in params["layers"]:
-        h = fused_layer_norm_affine(x, lyr["ln1_g"].astype(dt),
-                                    lyr["ln1_b"].astype(dt), (cfg.d_model,))
-        qkv = (h.reshape(B * S, -1) @ lyr["qkv"].astype(dt)).reshape(
-            B, S, 3, cfg.d_model)
-        scale = cfg.head_dim ** -0.5
-        # (B, S, D) -> (B, H, S, hd) per q/k/v
-        q = qkv[:, :, 0].reshape(B, S, H, -1).transpose(0, 2, 1, 3) * scale
-        k = qkv[:, :, 1].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
-        v = qkv[:, :, 2].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
-        ctx = attention_core(q, k, v, jnp.zeros((1, S, S), jnp.float32),
-                             causal=cfg.causal)
-        ctx = ctx.transpose(0, 2, 1, 3).reshape(B * S, cfg.d_model)
-        x = x + (ctx.astype(dt) @ lyr["out"].astype(dt)).reshape(x.shape)
-
-        h = fused_layer_norm_affine(x, lyr["ln2_g"].astype(dt),
-                                    lyr["ln2_b"].astype(dt), (cfg.d_model,))
-        moe_out, aux = moe_ffn(h.reshape(B * S, cfg.d_model), lyr["router"],
-                               lyr["w_in"], lyr["w_out"],
-                               axis_name=expert_axis,
-                               capacity_factor=cfg.capacity_factor)
-        x = x + moe_out.reshape(x.shape).astype(dt)
+        x, aux = layer(x, lyr, cfg, expert_axis)
         aux_total = aux_total + aux
 
     x = fused_layer_norm_affine(x, params["head_ln_g"].astype(dt),

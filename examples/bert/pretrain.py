@@ -171,8 +171,6 @@ def main(argv=None):
     args = parse_args(argv)
     if args.moe and (args.bert_large or args.zero):
         raise SystemExit("--moe combines with the standard path only")
-    if args.moe and args.remat:
-        raise SystemExit("--remat is not wired for the MoE path")
     if args.bert_large:
         cfg = bert_large_config(dtype=jnp.bfloat16, remat=args.remat)
     elif args.moe:
@@ -180,7 +178,7 @@ def main(argv=None):
             vocab_size=args.vocab, max_len=args.seq_len,
             num_layers=args.layers, d_model=args.d_model,
             num_heads=args.heads, d_ff=4 * args.d_model,
-            num_experts=args.moe, dtype=jnp.bfloat16)
+            num_experts=args.moe, dtype=jnp.bfloat16, remat=args.remat)
     else:
         cfg = TransformerConfig(
             vocab_size=args.vocab, max_len=args.seq_len,
