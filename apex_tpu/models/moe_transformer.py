@@ -128,10 +128,12 @@ def moe_transformer_apply(params, tokens, cfg: MoETransformerConfig, *,
     layer = _moe_layer
     if cfg.remat:
         # recompute the layer (attention + routed FFN, including the
-        # all_to_all when expert-parallel) in the backward pass;
-        # prevent_cse=False — the python loop bodies are already distinct
-        layer = jax.checkpoint(_moe_layer, prevent_cse=False,
-                               static_argnums=(2, 3))
+        # all_to_all when expert-parallel) in the backward pass.  Unlike
+        # the scan-based transformer, these are UNROLLED loop bodies in
+        # one HLO module, so the default prevent_cse=True barrier is
+        # required: without it XLA may CSE each recomputation against its
+        # original forward and keep the activations alive anyway.
+        layer = jax.checkpoint(_moe_layer, static_argnums=(2, 3))
     for lyr in params["layers"]:
         x, aux = layer(x, lyr, cfg, expert_axis)
         aux_total = aux_total + aux

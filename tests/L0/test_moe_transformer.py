@@ -110,3 +110,55 @@ def test_moe_remat_same_numerics():
                     jax.tree_util.tree_leaves(grads[True])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_expert_sharded_remat_grads():
+    """remat under expert parallelism: jax.checkpoint wrapping the layer's
+    all_to_all inside shard_map — gradients must match the non-remat
+    sharded path (guards checkpoint-vs-collective interactions across jax
+    upgrades)."""
+    import dataclasses
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), ("expert",))
+    cfg0 = dataclasses.replace(CFG, num_experts=n)
+    params = moe_transformer_init(jax.random.PRNGKey(4), cfg0)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(5),
+                                          (2, 16), 0, 256),
+             "targets": jax.random.randint(jax.random.PRNGKey(6),
+                                           (2, 16), 0, 256)}
+
+    def spec(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else ""
+        return P("expert") if name in ("w_in", "w_out") else P()
+    pspec = jax.tree_util.tree_map_with_path(spec, params)
+
+    def grads_for(remat):
+        cfg = dataclasses.replace(cfg0, remat=remat)
+        try:
+            smap = functools.partial(shard_map, mesh=mesh,
+                                     in_specs=(pspec, P()), out_specs=P(),
+                                     check_vma=False)
+        except TypeError:  # older jax
+            smap = functools.partial(shard_map, mesh=mesh,
+                                     in_specs=(pspec, P()), out_specs=P(),
+                                     check_rep=False)
+
+        @jax.jit
+        def g(params):
+            @smap
+            def f(p, tokens):
+                logits, aux = moe_transformer_apply(p, tokens, cfg,
+                                                    expert_axis="expert")
+                lp = jax.nn.log_softmax(logits)
+                loss = -jnp.mean(jnp.take_along_axis(
+                    lp, batch["targets"][..., None], axis=-1))
+                return loss + 0.01 * jax.lax.pmean(aux, "expert")
+            return jax.grad(lambda p_: f(p_, batch["tokens"]))(params)
+        return g(params)
+
+    g0 = grads_for(False)
+    g1 = grads_for(True)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
