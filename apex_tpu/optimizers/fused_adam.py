@@ -27,7 +27,9 @@ class FusedAdamState(NamedTuple):
 class FusedAdam(FusedOptimizer):
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-8, adam_w_mode=True, weight_decay=0.0, amsgrad=False,
-                 model_dtype=None, impl="xla"):
+                 set_grad_none=True, model_dtype=None, impl="xla"):
+        # set_grad_none: accepted for signature parity (fused_adam.py:62);
+        # torch .grad-clearing plumbing with no functional analog
         super().__init__(lr, weight_decay, impl)
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant "
