@@ -1,8 +1,8 @@
 #!/bin/bash
 # Probe the axon tunnel every 10 min; on recovery run both benches once
-# and save the JSON. Exits after success or ~4h of probing.
+# and save the JSON. Exits after success or ~10h of probing.
 cd /root/repo
-for i in $(seq 1 24); do
+for i in $(seq 1 60); do
   if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date +%H:%M:%S) tunnel healthy — running benches" >> tpu_watch.out
     timeout 500 python bench.py --inner > BENCH_TPU_r3.json 2>> tpu_watch.out
@@ -13,5 +13,5 @@ for i in $(seq 1 24); do
   echo "$(date +%H:%M:%S) probe $i: wedged" >> tpu_watch.out
   sleep 600
 done
-echo "gave up after 24 probes" >> tpu_watch.out
+echo "gave up after 60 probes" >> tpu_watch.out
 exit 1
