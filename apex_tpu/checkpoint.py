@@ -108,23 +108,80 @@ def save_sharded(path: str, tree) -> None:
     Overwrite is non-destructive: the new checkpoint is written to a
     sibling temp dir and swapped in; a preemption mid-save leaves either
     the old checkpoint at ``path`` or (between the two renames) at
-    ``path + ".old-*"`` — never zero checkpoints, matching the pickle
-    path's atomic posture."""
+    ``path + ".old"`` — never zero checkpoints, matching the pickle
+    path's atomic posture.
+
+    Multi-host protocol: orbax's save is *collective* — every process
+    writes only the shards it owns — so the temp dir name must be the
+    same on every process (a per-pid name would scatter shards across
+    directories and no directory would ever hold a complete checkpoint).
+    Filesystem mutations of the shared ``path`` (stale-tmp cleanup and
+    the final swap) run on process 0 only, fenced by global barriers so
+    no process races ahead of the swap."""
     import shutil
 
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    tmp = f"{path}.new-{os.getpid()}"
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(tmp, tree, force=True)
-    if os.path.exists(path):
-        old = f"{path}.old-{os.getpid()}"
-        os.rename(path, old)
-        os.rename(tmp, path)
-        shutil.rmtree(old, ignore_errors=True)
-    else:
-        os.rename(tmp, path)
+    tmp = f"{path}.new"
+    is_lead = jax.process_index() == 0
+    multihost = jax.process_count() > 1
+
+    def _barrier(tag: str) -> None:
+        if multihost:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"apex_tpu.save_sharded.{tag}")
+
+    if is_lead:
+        if not os.path.exists(path) and os.path.exists(f"{path}.old"):
+            # survivor of a save preempted between the two swap renames:
+            # .old is the last committed checkpoint — put it back before
+            # anything else so "never zero checkpoints" holds across the
+            # crash window (load_sharded has the matching fallback)
+            os.rename(f"{path}.old", path)
+        if os.path.exists(tmp):
+            # leftover from a previous preempted save; remove before the
+            # collective write so force=True semantics stay orbax-internal
+            shutil.rmtree(tmp, ignore_errors=True)
+    _barrier("pre_save")
+    # capture a save-phase failure instead of raising past the collective:
+    # a process that raises before the sync point strands its peers in the
+    # barrier — instead every process reaches the allgather, learns whether
+    # any peer failed, and they all raise together (clean job-level failure)
+    save_err: BaseException | None = None
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(tmp, tree, force=True)
+    except BaseException as e:
+        save_err = e
+    if multihost:
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        ok_all = multihost_utils.process_allgather(
+            _np.array([save_err is None]))
+        if not bool(ok_all.all()):
+            if save_err is not None:
+                raise save_err
+            raise RuntimeError(
+                "save_sharded: collective orbax save failed on a peer "
+                f"process (this rank ok); checkpoint left incomplete at {tmp}")
+    elif save_err is not None:
+        raise save_err
+    try:
+        if is_lead:
+            if os.path.exists(path):
+                old = f"{path}.old"
+                shutil.rmtree(old, ignore_errors=True)
+                os.rename(path, old)
+                os.rename(tmp, path)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.rename(tmp, path)
+    finally:
+        # barrier unconditionally: a lead-side OSError must not leave the
+        # other processes hanging in sync_global_devices — they release,
+        # the lead raises, and the job-level launcher sees the failure
+        _barrier("post_swap")
 
 
 def load_sharded(path: str, template):
@@ -134,5 +191,11 @@ def load_sharded(path: str, template):
     on the devices that own them, no host gather."""
     import orbax.checkpoint as ocp
 
+    path = os.path.abspath(path)
+    if not os.path.exists(path) and os.path.exists(f"{path}.old"):
+        # a save preempted between its two swap renames leaves the last
+        # committed checkpoint at .old; every process sees the same
+        # shared filesystem so this fallback is rank-consistent
+        path = f"{path}.old"
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(os.path.abspath(path), template)
+        return ckptr.restore(path, template)

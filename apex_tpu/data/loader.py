@@ -231,6 +231,14 @@ class NativeLoader:
         stop = threading.Event()
 
         def producer():
+            try:
+                _produce()
+            except BaseException as e:  # surface to the consumer: a dead
+                # producer with no sentinel would leave q.get() blocked
+                # forever (training hang instead of an error)
+                _put_checking_stop(q, e, stop)
+
+        def _produce():
             rng = np.random.RandomState(self.seed & 0x7fffffff)
             n = (1 if synthetic else self.source.data.shape[0])
             order = None
@@ -264,6 +272,8 @@ class NativeLoader:
                 item = q.get()
                 if item is None:
                     return
+                if isinstance(item, BaseException):
+                    raise item
                 x, y = item
                 if self.device_put:
                     yield jax.device_put(x), jax.device_put(y)

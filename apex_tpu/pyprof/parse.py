@@ -97,14 +97,26 @@ def _self_times(events: list[dict]) -> None:
         by_thread.setdefault((e["pid"], e["tid"]), []).append(e)
     for evs in by_thread.values():
         # parents first: earlier start, then longer duration
-        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        evs.sort(key=lambda e: (e["ts"], -e["dur"], e.get("name", "")))
         stack: list[dict] = []
         for e in evs:
             e["self_us"] = e["dur"]
             while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"]:
                 stack.pop()
-            if stack:   # e is a direct child of stack[-1]
-                stack[-1]["self_us"] -= e["dur"]
+            if stack:
+                p = stack[-1]
+                if e["ts"] + e["dur"] <= p["ts"] + p["dur"]:
+                    # e nests in p (incl. equal bounds).  Clamp the debit:
+                    # real Chrome traces emit equal-bound twin spans whose
+                    # parent/child order is arbitrary — an unclamped
+                    # subtract drives self_us negative, while skipping the
+                    # subtract double-counts (per-thread self would exceed
+                    # wall time).  Clamping keeps genuine nesting exact
+                    # (a valid parent's remaining self always covers its
+                    # sequential children) and degenerate twins at zero.
+                    p["self_us"] -= min(e["dur"], max(p["self_us"], 0.0))
+                # else: partial overlap (malformed trace) — keep e on the
+                # stack for pop bookkeeping but don't debit p
             stack.append(e)
 
 

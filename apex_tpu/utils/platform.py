@@ -167,8 +167,25 @@ def cpu_platform(n_devices: int | None = None):
             pass
 
 
-def probe_ambient_backend(timeout: float = 75.0) -> bool:
-    """True iff a fresh process can bring up the ambient jax backend within
+class ProbeResult:
+    """Truthy iff the probe succeeded; ``detail`` preserves the failure
+    mode (timeout vs fast nonzero exit + stderr tail) so a bench JSON on
+    a flaky tunnel records *why* the backend was unreachable, not just
+    that it was."""
+
+    def __init__(self, ok: bool, detail: str):
+        self.ok = ok
+        self.detail = detail
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"ProbeResult(ok={self.ok}, detail={self.detail!r})"
+
+
+def probe_ambient_backend(timeout: float = 75.0) -> ProbeResult:
+    """Truthy iff a fresh process can bring up the ambient jax backend within
     ``timeout`` — run as a killable SUBPROCESS because a wedged tunnel dial
     blocks in C++ and cannot be interrupted in-process.  Single source for
     the tunnel health probe (bench.py and driver entry points share it)."""
@@ -178,9 +195,16 @@ def probe_ambient_backend(timeout: float = 75.0) -> bool:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
             capture_output=True, timeout=timeout)
-        return r.returncode == 0
-    except Exception:
-        return False
+        if r.returncode == 0:
+            return ProbeResult(True, "ok")
+        tail = (r.stderr or b"")[-300:].decode("utf-8", "replace").strip()
+        return ProbeResult(
+            False, f"probe exited rc={r.returncode}: {tail or '<no stderr>'}")
+    except subprocess.TimeoutExpired:
+        return ProbeResult(False, f"probe timeout after {timeout:.0f}s "
+                                  "(tunnel wedged)")
+    except Exception as e:  # pragma: no cover
+        return ProbeResult(False, f"probe failed to launch: {e!r}")
 
 
 def ensure_live_backend(probe_timeout: float = 75.0) -> str:

@@ -371,7 +371,7 @@ def main():
     from apex_tpu.utils.platform import probe_ambient_backend
     healthy = probe_ambient_backend(75)
     if not healthy:
-        attempt_errs.append("probe timeout (tunnel wedged)")
+        attempt_errs.append(healthy.detail)
     attempts = 2 if healthy else 0
 
     for attempt in range(attempts):

@@ -333,7 +333,7 @@ def main():
         except subprocess.TimeoutExpired:
             err = "inner timeout"
     else:
-        err = "tunnel unhealthy"
+        err = healthy.detail
     from apex_tpu.utils.platform import force_cpu
     force_cpu()
     deadline = time.monotonic() + 240.0

@@ -95,6 +95,29 @@ def test_python_fallback_same_contract(monkeypatch):
     assert sorted(seen) == list(range(n))
 
 
+def test_python_fallback_producer_error_surfaces(monkeypatch):
+    """A producer-thread crash must raise in the consumer, not hang it
+    (the advisor's finding: no sentinel on unexpected death left q.get()
+    blocked forever)."""
+    import pytest
+    from apex_tpu.data import loader as L
+    monkeypatch.setattr(L, "_load", lambda: None)
+    src = _indexed_source(16)
+
+    real_shape = src.data.shape
+
+    class Bomb:
+        shape = real_shape
+
+        def __getitem__(self, idx):
+            raise RuntimeError("bad memmap index")
+
+    src.data = Bomb()
+    it = iter(NativeLoader(src, batch_size=4, steps=4, seed=0))
+    with pytest.raises(RuntimeError, match="bad memmap index"):
+        next(it)
+
+
 def test_native_engine_compiles():
     """The toolchain is baked into this image; the native path must be
     genuinely exercised in CI, not silently skipped via the fallback."""

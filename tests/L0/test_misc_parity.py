@@ -300,3 +300,36 @@ def test_orbax_sharded_checkpoint_roundtrip(tmp_path):
                     jax.tree_util.tree_leaves(got)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert b.sharding.is_equivalent_to(a.sharding, a.ndim)
+
+
+def test_orbax_interrupted_swap_recovery(tmp_path):
+    """A save preempted between the swap's two renames leaves the last
+    committed checkpoint at ``path + ".old"``; load_sharded must fall
+    back to it and the next save_sharded must restore it before
+    proceeding ("never zero checkpoints")."""
+    import os
+    import pytest
+    pytest.importorskip("orbax.checkpoint")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu import checkpoint
+
+    tree = {"w": jnp.arange(8, dtype=jnp.float32)}
+    template = {"w": jnp.zeros(8, dtype=jnp.float32)}
+    path = str(tmp_path / "ckpt")
+    checkpoint.save_sharded(path, tree)
+    # simulate the crash window: path renamed away, new save never landed
+    os.rename(path, path + ".old")
+
+    got = checkpoint.load_sharded(path, template)        # .old fallback
+    np.testing.assert_array_equal(np.asarray(got["w"]),
+                                  np.asarray(tree["w"]))
+
+    tree2 = {"w": 2.0 * jnp.arange(8, dtype=jnp.float32)}
+    checkpoint.save_sharded(path, tree2)                 # recovers + swaps
+    got2 = checkpoint.load_sharded(path, template)
+    np.testing.assert_array_equal(np.asarray(got2["w"]),
+                                  np.asarray(tree2["w"]))
+    assert not os.path.exists(path + ".old")
+    assert not os.path.exists(path + ".new")

@@ -22,7 +22,8 @@ ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _run_two_process(worker_filename, timeout=120, attempts=3):
+def _run_two_process(worker_filename, timeout=120, attempts=3,
+                     extra_env=None):
     """Launch ``worker_filename`` under the multiproc launcher on 2 ranks
     (2 virtual devices each) over a fresh loopback coordinator port;
     returns [(proc, output), ...] after asserting both exited cleanly.
@@ -40,8 +41,8 @@ def _run_two_process(worker_filename, timeout=120, attempts=3):
     flags = (flags + " --xla_force_host_platform_device_count=2").strip()
     pythonpath = os.pathsep.join(
         p for p in (ROOT, os.environ.get("PYTHONPATH", "")) if p)
-    env = dict(os.environ, PYTHONPATH=pythonpath, JAX_PLATFORMS="cpu",
-               XLA_FLAGS=flags)
+    env = {**os.environ, "PYTHONPATH": pythonpath, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": flags, **(extra_env or {})}
 
     failures = []
     for attempt in range(attempts):
@@ -107,6 +108,25 @@ def test_two_process_amp_master_params():
     digests = []
     for rank, (_, out) in enumerate(results):
         m = re.search(rf"AMPOK rank={rank} digest=([0-9.]+)", out)
+        assert m, out[-2000:]
+        digests.append(m.group(1))
+    assert digests[0] == digests[1], digests
+
+
+def test_two_process_sharded_checkpoint(tmp_path):
+    """save_sharded across a REAL process boundary: collective orbax write
+    into one deterministic temp dir, lead-only barrier-fenced swap.  Both
+    ranks must restore identical content and leave no .new/.old debris."""
+    import pytest
+    pytest.importorskip("orbax.checkpoint")
+    ckpt = str(tmp_path / "ckpt_mp")
+    results = _run_two_process(
+        "_mp_ckpt_worker.py", timeout=180,
+        extra_env={"APEX_TPU_TEST_CKPT": ckpt})
+    digests = []
+    for rank, (_, out) in enumerate(results):
+        m = re.search(
+            rf"CKPTOK rank={rank} digest=([0-9.]+) leftover=\[\]", out)
         assert m, out[-2000:]
         digests.append(m.group(1))
     assert digests[0] == digests[1], digests

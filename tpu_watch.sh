@@ -1,17 +1,31 @@
 #!/bin/bash
-# Probe the axon tunnel every 10 min; on recovery run both benches once
-# and save the JSON. Exits after success or ~10h of probing.
+# Round-4 tunnel watcher. Probe the axon tunnel every 5 min; on recovery
+# run both benches once (seize the window before a re-wedge), save the
+# JSON under r4 names, leave a TUNNEL_LIVE marker for the interactive
+# session, and exit. Gives up after ~12h of probing.
+#
+# Single-client tunnel: while this script is running it OWNS the chip.
+# The interactive session must kill it before dialing the tunnel itself
+# (see docs/tpu_tunnel.md; pkill -f "bash tpu_watch").
 cd /root/repo
-for i in $(seq 1 60); do
-  if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+for i in $(seq 1 144); do
+  # single source for probe + failure formatting: platform.ProbeResult
+  out=$(timeout 90 python -c "from apex_tpu.utils.platform import probe_ambient_backend as p
+r = p(75); print(r.detail); raise SystemExit(0 if r else 1)" 2>&1)
+  rc=$?
+  if [ $rc -eq 0 ]; then
     echo "$(date +%H:%M:%S) tunnel healthy — running benches" >> tpu_watch.out
-    timeout 500 python bench.py --inner > BENCH_TPU_r3.json 2>> tpu_watch.out
-    timeout 650 python bench_kernels.py --inner > BENCH_KERNELS_TPU_r3.json 2>> tpu_watch.out
-    echo "$(date +%H:%M:%S) benches done rc=$?" >> tpu_watch.out
+    timeout 500 python bench.py --inner > BENCH_TPU_r4.json 2>> tpu_watch.out
+    echo "$(date +%H:%M:%S) bench.py done rc=$?" >> tpu_watch.out
+    timeout 650 python bench_kernels.py --inner > BENCH_KERNELS_TPU_r4.json 2>> tpu_watch.out
+    echo "$(date +%H:%M:%S) bench_kernels.py done rc=$?" >> tpu_watch.out
+    # marker LAST: it invites the interactive session to kill this script
+    # and take the (single-client) tunnel — must not race the bench runs
+    date -u +%Y-%m-%dT%H:%M:%SZ > TUNNEL_LIVE
     exit 0
   fi
-  echo "$(date +%H:%M:%S) probe $i: wedged" >> tpu_watch.out
-  sleep 600
+  echo "$(date +%H:%M:%S) probe $i: $(printf '%s' "$out" | tr '\n' ' ')" >> tpu_watch.out
+  sleep 300
 done
-echo "gave up after 60 probes" >> tpu_watch.out
+echo "gave up after 144 probes" >> tpu_watch.out
 exit 1
