@@ -4,18 +4,34 @@ These are the TPU equivalents of the ``amp_C`` kernel family
 (``csrc/amp_C_frontend.cpp:1-136`` + ``multi_tensor_*.cu``): fused elementwise
 updates over *flat packed buffers* (see ``flattener.py``) instead of pointer
 tables.  Each kernel views the flat (total,) buffer as (rows, 128) and walks a
-1-D grid of chunks; per-chunk blocks live in VMEM, hyperparameter scalars ride
-in SMEM, and outputs alias their inputs (donation) so updates are in-place in
-HBM like the CUDA originals.
+1-D grid of chunks; per-chunk blocks live in VMEM and hyperparameter scalars
+ride in SMEM.
 
-The overflow short-circuit (``noop_flag`` in ``multi_tensor_apply.cuh``)
-becomes an i32 "overflow" output accumulated across the sequential TPU grid.
+Tuned to the on-chip measurements in PERF_NOTES.md §2 (round 3, v5e):
+
+- grid steps are declared ``parallel`` — the round-2 sequential-grid
+  SMEM overflow-flag accumulation (init at step 0 + read-modify-write
+  each step, mirroring ``multi_tensor_apply.cuh``'s ``noop_flag``)
+  forced ``arbitrary`` semantics and serialized the pipeline (~10x
+  slower).  The overflow flag is now ONE XLA ``isfinite`` reduce over
+  the kernel's output — non-finite inputs propagate to the output (and
+  a low-precision cast overflow shows up there too), so checking the
+  output preserves the reference's input-or-output flag semantics.
+- no ``input_output_aliases``: in-kernel donation measured ~1.6x SLOWER
+  on TPU — the opposite of the CUDA in-place intuition.  The kernels
+  therefore write fresh output buffers; memory-bound callers (the ZeRO
+  optimizers with shard sizes near HBM capacity) recover the in-place
+  footprint by donating the optimizer state at THEIR jit boundary
+  (``jax.jit(step, donate_argnums=...)``) — buffer reuse then happens in
+  XLA's allocator, outside the kernel's pipeline, without the aliasing
+  penalty.  Our own jit sites (``__graft_entry__._dryrun_zero_leg``,
+  the 2-process ZeRO worker) do this.
+- ``multi_tensor_l2norm`` keeps its sequential single-cell accumulation:
+  it measured FASTER than the XLA reduce (1.17 ms vs 1.65 ms on 1.34 GB).
 
 On non-TPU backends (CPU tests) kernels run in Pallas interpret mode.
 """
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -41,14 +57,12 @@ def _block_rows(total: int) -> int:
     return max(br, 1)
 
 
-def _grid_call(kernel, flats, out_dtypes, *, scalars=None, aliases=None,
-               with_flag=False, block_rows=None):
-    """Run ``kernel`` over 1-D flat buffers chunked as (block_rows, LANE).
+def _grid_call(kernel, flats, out_dtypes, *, scalars=None, block_rows=None):
+    """Run ``kernel`` over 1-D flat buffers chunked as (block_rows, LANE)
+    with ``parallel`` grid semantics (PERF_NOTES §2).
 
     flats: list of (total,) arrays (equal length).  scalars: optional (1, S)
-    f32 array placed in SMEM.  aliases: dict input_index->output_index for
-    in-place donation.  with_flag: append an i32 (1,1) overflow-flag output
-    accumulated over the grid.
+    f32 array placed in SMEM.
     """
     total = flats[0].shape[0]
     if block_rows is None:
@@ -74,15 +88,6 @@ def _grid_call(kernel, flats, out_dtypes, *, scalars=None, aliases=None,
     out_specs = [pl.BlockSpec((block_rows, LANE), lambda i: (i, 0),
                               memory_space=pltpu.VMEM)
                  for _ in out_dtypes]
-    if with_flag:
-        out_shape.append(_sds((1, 1), jnp.int32, vma))
-        out_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0),
-                                      memory_space=pltpu.SMEM))
-
-    io_aliases = {}
-    if aliases:
-        off = 0 if scalars is None else 1
-        io_aliases = {k + off: v for k, v in aliases.items()}
 
     outs = pl.pallas_call(
         kernel,
@@ -90,17 +95,20 @@ def _grid_call(kernel, flats, out_dtypes, *, scalars=None, aliases=None,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        input_output_aliases=io_aliases,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=_interpret(),
     )(*ins)
     if not isinstance(outs, (list, tuple)):
         outs = (outs,)
-    outs = list(outs)
-    flag = None
-    if with_flag:
-        flag = outs.pop()[0, 0]
-    outs = [o.reshape(total) for o in outs]
-    return outs, flag
+    return [o.reshape(total) for o in outs]
+
+
+def _overflow_flag(flat_out) -> jax.Array:
+    """i32 0/1 overflow flag — ONE XLA reduce over the kernel output,
+    replacing the serializing in-kernel SMEM flag (PERF_NOTES §2)."""
+    return jnp.logical_not(jnp.all(jnp.isfinite(
+        flat_out.astype(jnp.float32)))).astype(jnp.int32)
 
 
 # --------------------------------------------------------------------------
@@ -112,23 +120,12 @@ def multi_tensor_scale(flat_in, scale, out_dtype=None):
     out_dtype = jnp.dtype(out_dtype or flat_in.dtype)
     scalars = jnp.reshape(jnp.asarray(scale, jnp.float32), (1, 1))
 
-    def kernel(s_ref, x_ref, o_ref, flag_ref):
-        i = pl.program_id(0)
-
-        @pl.when(i == 0)
-        def _():
-            flag_ref[0, 0] = 0
-
+    def kernel(s_ref, x_ref, o_ref):
         y = x_ref[:].astype(jnp.float32) * s_ref[0, 0]
         o_ref[:] = y.astype(o_ref.dtype)
 
-        @pl.when(jnp.logical_not(jnp.all(jnp.isfinite(y))))
-        def _():
-            flag_ref[0, 0] = 1
-
-    (out,), flag = _grid_call(kernel, [flat_in], [out_dtype],
-                              scalars=scalars, with_flag=True)
-    return out, flag
+    (out,) = _grid_call(kernel, [flat_in], [out_dtype], scalars=scalars)
+    return out, _overflow_flag(out)
 
 
 # --------------------------------------------------------------------------
@@ -140,29 +137,21 @@ def multi_tensor_axpby(flat_x, flat_y, a, b, out_dtype=None):
     scalars = jnp.stack([jnp.asarray(a, jnp.float32),
                          jnp.asarray(b, jnp.float32)]).reshape(1, 2)
 
-    def kernel(s_ref, x_ref, y_ref, o_ref, flag_ref):
-        i = pl.program_id(0)
-
-        @pl.when(i == 0)
-        def _():
-            flag_ref[0, 0] = 0
-
+    def kernel(s_ref, x_ref, y_ref, o_ref):
         r = (x_ref[:].astype(jnp.float32) * s_ref[0, 0]
              + y_ref[:].astype(jnp.float32) * s_ref[0, 1])
         o_ref[:] = r.astype(o_ref.dtype)
 
-        @pl.when(jnp.logical_not(jnp.all(jnp.isfinite(r))))
-        def _():
-            flag_ref[0, 0] = 1
-
-    (out,), flag = _grid_call(kernel, [flat_x, flat_y], [out_dtype],
-                              scalars=scalars, with_flag=True)
-    return out, flag
+    (out,) = _grid_call(kernel, [flat_x, flat_y], [out_dtype],
+                        scalars=scalars)
+    return out, _overflow_flag(out)
 
 
 # --------------------------------------------------------------------------
 # multi_tensor_l2norm (multi_tensor_l2norm_kernel.cu): the CUDA two-stage
 # reduction collapses into sequential accumulation over the TPU grid.
+# Kept sequential on purpose: measured FASTER than the XLA reduce
+# (PERF_NOTES §2: 1.17 ms vs 1.65 ms over 1.34 GB).
 # --------------------------------------------------------------------------
 
 def multi_tensor_l2norm(flat_in):
@@ -173,9 +162,10 @@ def multi_tensor_l2norm(flat_in):
     br = _block_rows(total)
     grid = rows // br
 
-    # TPU grid steps run sequentially, so the sum accumulates into one (1, 1)
-    # SMEM cell (the two-stage partials of multi_tensor_l2norm_kernel.cu:197
-    # collapse into sequential accumulation).
+    # TPU grid steps run sequentially under `arbitrary` semantics, so the
+    # sum accumulates into one (1, 1) SMEM cell (the two-stage partials of
+    # multi_tensor_l2norm_kernel.cu:197 collapse into sequential
+    # accumulation).
     def kernel(x_ref, acc_ref):
         @pl.when(pl.program_id(0) == 0)
         def _():
@@ -192,6 +182,8 @@ def multi_tensor_l2norm(flat_in):
         out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0),
                                memory_space=pltpu.SMEM),
         out_shape=_sds((1, 1), jnp.float32, _out_vma(flat_in)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
         interpret=_interpret(),
     )(flat_in.reshape(rows, LANE))
     return jnp.sqrt(sumsq[0, 0])
@@ -214,7 +206,8 @@ def fused_adam_flat(flat_g, flat_p, flat_m, flat_v, scalars, *,
     def kernel(s_ref, g_ref, p_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref,
                *maybe_model):
         lr, b1, b2, eps = s_ref[0, 0], s_ref[0, 1], s_ref[0, 2], s_ref[0, 3]
-        wd, rc1, rc2, inv_scale = s_ref[0, 4], s_ref[0, 5], s_ref[0, 6], s_ref[0, 7]
+        wd, rc1, rc2, inv_scale = (s_ref[0, 4], s_ref[0, 5], s_ref[0, 6],
+                                   s_ref[0, 7])
         g = g_ref[:].astype(jnp.float32) * inv_scale
         p = p_ref[:]
         if not adam_w_mode:
@@ -231,10 +224,8 @@ def fused_adam_flat(flat_g, flat_p, flat_m, flat_v, scalars, *,
         if maybe_model:
             maybe_model[0][:] = p_new.astype(maybe_model[0].dtype)
 
-    aliases = {1: 0, 2: 1, 3: 2}  # p, m, v in-place
-    outs, _ = _grid_call(kernel, [flat_g, flat_p, flat_m, flat_v], out_dtypes,
-                         scalars=scalars, aliases=aliases)
-    return outs  # [p, m, v] (+ model copy)
+    return _grid_call(kernel, [flat_g, flat_p, flat_m, flat_v], out_dtypes,
+                      scalars=scalars)  # [p, m, v] (+ model copy)
 
 
 # --------------------------------------------------------------------------
@@ -268,10 +259,9 @@ def fused_lamb_stage1_flat(flat_g, flat_p, flat_m, flat_v, scalars, *,
         mo_ref[:] = m
         vo_ref[:] = v
 
-    outs, _ = _grid_call(kernel, [flat_g, flat_p, flat_m, flat_v],
-                         [jnp.float32, jnp.float32, jnp.float32],
-                         scalars=scalars, aliases={2: 1, 3: 2})
-    return outs  # [update, m, v]
+    return _grid_call(kernel, [flat_g, flat_p, flat_m, flat_v],
+                      [jnp.float32, jnp.float32, jnp.float32],
+                      scalars=scalars)  # [update, m, v]
 
 
 # NOTE: the SGD/Adagrad Pallas kernels were retired in round 3 — the fused

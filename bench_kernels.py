@@ -286,6 +286,23 @@ def bench_multi_tensor(results, on_tpu):
                                       "sharded ZeRO path; optimizers use "
                                       "the XLA math (PERF_NOTES §2)")
 
+    # LAMB stage 1 (4-in/3-out) — the other ZeRO impl='fused' kernel;
+    # this A/B decides whether ZeRO's default ever flips from 'xla'
+    lamb_s = jnp.asarray([[0.9, 0.999, 1e-8, 0.01, 1.1, 1.2, 1.0, 1.0,
+                           0.1]], jnp.float32)
+
+    def xla_lamb1(g, p, m, v):
+        m2 = 0.9 * m + 0.1 * g
+        v2 = 0.999 * v + 0.001 * g * g
+        u = (m2 * 1.1) / (jnp.sqrt(v2 * 1.2) + 1e-8) + 0.01 * p
+        return u, m2, v2
+
+    results["lamb_stage1"] = ab(
+        "lamb_stage1",
+        jax.jit(lambda g, p, m, v: K.fused_lamb_stage1_flat(
+            g, p, m, v, lamb_s)),
+        jax.jit(xla_lamb1), flat, flat2, m, v)
+
 
 def run(budget_left=lambda: 1e9):
     on_tpu = jax.default_backend() == "tpu"

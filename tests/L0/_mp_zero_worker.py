@@ -52,7 +52,8 @@ def init_fn(p):
     return opt.init(p)
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=0)   # in-place state (HBM reuse
+# at the jit boundary — the kernels themselves never alias, PERF_NOTES §2)
 @functools.partial(shard_map, mesh=mesh, in_specs=(sspec, rep, rep),
                    out_specs=(rep, sspec))
 def step_fn(state, grads, p):

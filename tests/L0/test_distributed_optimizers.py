@@ -58,8 +58,12 @@ def run_sharded(opt, params, n_dev=8, iters=ITERS, mesh=None, specs=None,
 
     check_vma stays at the default (True) for the xla impl — validating the
     state specs and the all_gather_invariant replication claim — but must be
-    False for impl='fused': interpret-mode pallas (the CPU test path) cannot
-    type in-kernel constants under vma checking (compiled TPU pallas can).
+    False for impl='fused': jax's pallas interpreter (the CPU test path)
+    materializes the grid loop's output carry without vma typing, so ANY
+    interpret-mode pallas_call under check_vma=True fails in the
+    while_loop type check ("carry[i] ... varying manual axes do not
+    match") regardless of how the kernel's inputs/outputs are typed.
+    Compiled TPU pallas is unaffected.
     """
     mesh = mesh or _mesh((n_dev,), ("data",))
     specs = specs if specs is not None else P(*(mesh.axis_names))
