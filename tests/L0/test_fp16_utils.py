@@ -1,0 +1,199 @@
+"""fp16_utils (legacy manual mixed precision) tests — this surface had no
+dedicated coverage before round 4.  Mirrors how the reference exercises it:
+``tests/L0/run_fp16util/test_fp16util.py`` (network conversion / param
+lists) and the FP16_Optimizer flows from the pre-amp docs (backward →
+update_master_grads → [clip] → step, plus the closure retry loop)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.fp16_utils import (
+    DynamicLossScaler, FP16_Optimizer, LossScaler, convert_network,
+    master_params_to_model_params, model_grads_to_master_grads,
+    network_to_half, prep_param_lists, tofp16)
+from apex_tpu.optimizers import FusedSGD
+
+
+def _params():
+    return {"fc": {"w": jnp.ones((8, 4), jnp.float32),
+                   "b": jnp.zeros((4,), jnp.float32)},
+            "bn": {"scale": jnp.ones((4,), jnp.float32),
+                   "bias": jnp.zeros((4,), jnp.float32)}}
+
+
+def test_network_conversion_and_bn_safety():
+    p = _params()
+    half = network_to_half(p)
+    assert all(l.dtype == jnp.float16
+               for l in jax.tree_util.tree_leaves(half))
+    assert tofp16(p)["fc"]["w"].dtype == jnp.float16
+    conv = convert_network(p, jnp.float16, keep_batchnorm_fp32=True)
+    assert conv["fc"]["w"].dtype == jnp.float16
+    # norm-layer params stay fp32 (fp16util.py:60 BN-safety)
+    assert conv["bn"]["scale"].dtype == jnp.float32
+
+
+def test_prep_param_lists_and_copies():
+    p = network_to_half(_params())
+    model, master = prep_param_lists(p)
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(master))
+    g32 = model_grads_to_master_grads(
+        jax.tree_util.tree_map(lambda x: jnp.ones_like(x), model))
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(g32))
+    back = master_params_to_model_params(model, master)
+    assert back["fc"]["w"].dtype == jnp.float16
+
+    # flat_master packs one fp32 buffer (the apex_C.flatten path)
+    model, (fl, flat) = prep_param_lists(p, flat_master=True)
+    assert flat.dtype == jnp.float32 and flat.ndim == 1
+    back = master_params_to_model_params(model, (fl, flat))
+    assert back["fc"]["w"].dtype == jnp.float16
+    np.testing.assert_allclose(np.asarray(back["fc"]["w"], np.float32),
+                               np.asarray(model["fc"]["w"], np.float32))
+
+
+def test_loss_scalers_legacy_api_and_defaults():
+    s = LossScaler(128.0)
+    assert s.loss_scale == 128.0
+    assert float(s.backward(jnp.float32(2.0))) == 256.0
+    g = s.scale_gradient({"w": jnp.full((4,), 128.0)})
+    np.testing.assert_allclose(np.asarray(g["w"]), 1.0)
+    s.update_scale(False)                 # static: no-op
+    assert s.loss_scale == 128.0
+
+    d = DynamicLossScaler()               # legacy defaults 2**32 / 1000
+    assert d.loss_scale == 2.0 ** 32
+    assert d.has_overflow({"w": jnp.array([jnp.inf])})
+    assert not d.has_overflow({"w": jnp.array([1.0])})
+    d.update_scale(True)
+    assert d.loss_scale == 2.0 ** 31
+
+
+def _quadratic_setup(scale=64.0):
+    params = {"w": jnp.full((4,), 4.0, jnp.float32)}
+    opt = FP16_Optimizer(FusedSGD(lr=0.5), params,
+                         static_loss_scale=scale)
+
+    def scaled_grads(masters):
+        # d/dw of 0.5*w^2 = w, scaled the way .backward() would
+        return jax.tree_util.tree_map(lambda w: w * scale, masters)
+    return opt, scaled_grads
+
+
+def test_fp16_optimizer_one_shot_step_descends():
+    opt, sg = _quadratic_setup()
+    for _ in range(3):
+        opt.step(sg(opt.master_params))
+    # w <- w - 0.5*w per step: 4 -> 2 -> 1 -> 0.5
+    np.testing.assert_allclose(np.asarray(opt.master_params["w"]), 0.5)
+    assert not opt.overflow
+
+
+def test_fp16_optimizer_staged_flow_with_clip():
+    """backward -> update_master_grads -> clip_master_grads -> step(),
+    the ported-script flow (reference fp16_optimizer.py:272,417,436)."""
+    opt, sg = _quadratic_setup()
+    g32 = opt.update_master_grads(sg(opt.master_params))
+    np.testing.assert_allclose(np.asarray(g32["w"]), 4.0)   # unscaled
+    clipped, norm = opt.clip_master_grads(g32, max_norm=1.0)
+    assert float(norm) == pytest.approx(8.0)                 # ||(4,4,4,4)||
+    opt.step(grads32=clipped)
+    # update = 0.5 * 4/8 = 0.25 per element
+    np.testing.assert_allclose(np.asarray(opt.master_params["w"]), 3.75,
+                               rtol=1e-6)
+
+    # no-arg step consumes staged grads
+    opt.update_master_grads(sg(opt.master_params))
+    opt.step()
+    np.testing.assert_allclose(np.asarray(opt.master_params["w"]),
+                               3.75 / 2, rtol=1e-6)
+    with pytest.raises(RuntimeError, match="update_master_grads"):
+        opt.step()                                           # nothing staged
+
+
+def test_fp16_optimizer_overflow_skips_and_halves():
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = FP16_Optimizer(FusedSGD(lr=0.1), params, dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2.0 ** 16})
+    bad = {"w": jnp.full((4,), jnp.inf)}
+    opt.step(bad)
+    assert opt.overflow
+    np.testing.assert_allclose(np.asarray(opt.master_params["w"]), 1.0)
+    assert opt.loss_scale == 2.0 ** 15
+
+
+def test_fp16_optimizer_closure_retries_until_finite():
+    """step(closure): re-evaluates grads after each overflow with the
+    halved scale — the reference's _step_with_closure loop."""
+    params = {"w": jnp.full((4,), 4.0, jnp.float32)}
+    opt = FP16_Optimizer(FusedSGD(lr=0.5), params, dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2.0 ** 16})
+    calls = {"n": 0}
+
+    def closure():
+        calls["n"] += 1
+        s = opt.loss_scale
+        if s > 2.0 ** 14:               # "overflows" until scale drops 2x
+            return {"w": jnp.full((4,), jnp.inf)}
+        return jax.tree_util.tree_map(lambda w: w * s, opt.master_params)
+
+    opt.step(closure=closure)
+    assert calls["n"] == 3              # 2 overflow retries + 1 success
+    np.testing.assert_allclose(np.asarray(opt.master_params["w"]), 2.0)
+
+    always_bad = lambda: {"w": jnp.full((4,), jnp.inf)}   # noqa: E731
+    with pytest.raises(FloatingPointError, match="20 loss-scale"):
+        opt.step(closure=always_bad)
+
+
+def test_fp16_optimizer_unstaged_grads32_still_guarded():
+    """step(grads32=) without a prior update_master_grads must still run
+    the finiteness check — no path may write non-finite masters."""
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = FP16_Optimizer(FusedSGD(lr=0.1), params, dynamic_loss_scale=True)
+    opt.step(grads32={"w": jnp.full((4,), jnp.inf)})
+    assert opt.overflow
+    np.testing.assert_allclose(np.asarray(opt.master_params["w"]), 1.0)
+
+
+def test_fp16_optimizer_closure_static_scale_skips_not_raises():
+    """With a static scaler a retry cannot change the outcome: one
+    non-finite evaluation -> skip the step (parity with the non-closure
+    paths), not 20 re-evaluations + FloatingPointError."""
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = FP16_Optimizer(FusedSGD(lr=0.1), params, static_loss_scale=64.0)
+    calls = {"n": 0}
+
+    def closure():
+        calls["n"] += 1
+        return {"w": jnp.full((4,), jnp.inf)}
+
+    opt.step(closure=closure)
+    assert calls["n"] == 1 and opt.overflow
+    np.testing.assert_allclose(np.asarray(opt.master_params["w"]), 1.0)
+
+
+def test_fp16_optimizer_one_shot_clears_stale_stage():
+    """A one-shot step between update_master_grads and a bare step() must
+    drop the stale staged grads (no silent double-apply)."""
+    opt, sg = _quadratic_setup()
+    opt.update_master_grads(sg(opt.master_params))
+    opt.step(sg(opt.master_params))          # one-shot path
+    with pytest.raises(RuntimeError, match="update_master_grads"):
+        opt.step()                           # stale stage must be gone
+
+
+def test_fp16_optimizer_state_dict_roundtrip():
+    opt, sg = _quadratic_setup()
+    opt.step(sg(opt.master_params))
+    blob = opt.state_dict()
+
+    opt2, _ = _quadratic_setup()
+    opt2.load_state_dict(blob)
+    np.testing.assert_allclose(np.asarray(opt2.master_params["w"]),
+                               np.asarray(opt.master_params["w"]))
+    assert float(opt2.loss_scale) == float(opt.loss_scale)

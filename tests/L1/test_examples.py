@@ -51,6 +51,17 @@ def test_bert_example():
     assert np.isfinite(loss)
 
 
+def test_bert_example_fast_attention():
+    """--attn fast trains through the contrib flash kernel (interpret
+    mode on CPU) — the reference examples' fast_self_multihead_attn
+    switch, exercised e2e inside a training step."""
+    ex = _load("examples/bert/pretrain.py", "ex_bert_fast")
+    loss = ex.main(["--steps", "3", "--batch-size", "2", "--seq-len", "32",
+                    "--d-model", "64", "--layers", "1", "--vocab", "256",
+                    "--attn", "fast", "--print-freq", "3"])
+    assert np.isfinite(loss)
+
+
 def test_imagenet_example_native_loader(tmp_path):
     """--loader native drives the C++ prefetch engine end to end, both
     synthetic and memmapped-npy data."""
