@@ -73,7 +73,8 @@ class SelfMultiheadAttn:
     def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
                  include_norm_add=False, impl="fast",
                  separate_qkv_params=False, mask_additive=False,
-                 seq_parallel_axis="seq", causal=False):
+                 seq_parallel_axis="seq", causal=False,
+                 seq_inner_impl="default"):
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.dropout = dropout
@@ -87,12 +88,23 @@ class SelfMultiheadAttn:
         self.separate_qkv_params = separate_qkv_params
         self.mask_additive = mask_additive
         self.seq_parallel_axis = seq_parallel_axis
-        self.causal = causal        # impl="ring" only (global causality)
+        self.causal = causal        # ring/ulysses only (global causality)
+        # impl="ulysses" inner core: "fast" runs the flash kernel on the
+        # gathered-sequence leg (ulysses_flash_attention) — the
+        # long-context composition; ring's cross-device online-softmax
+        # has no separate inner core to swap
+        self.seq_inner_impl = seq_inner_impl
         if mask_additive:
             assert not include_norm_add, \
                 "additive mask not supported with layer norm"
         if impl not in ("fast", "default", "ring", "ulysses"):
             raise AssertionError(f"Unsupported impl: {impl} !")
+        if seq_inner_impl not in ("default", "fast"):
+            raise AssertionError(
+                f"Unsupported seq_inner_impl: {seq_inner_impl} !")
+        if seq_inner_impl == "fast" and impl != "ulysses":
+            raise AssertionError(
+                "seq_inner_impl='fast' applies to impl='ulysses' only")
 
     def init_params(self, key):
         E = self.embed_dim
@@ -196,9 +208,14 @@ class SelfMultiheadAttn:
                     "constructor causal= flag; per-call masks are "
                     "unsupported")
             from ...parallel.sequence import (ring_attention,
-                                              ulysses_attention)
-            seq_fn = (ring_attention if self.impl == "ring"
-                      else ulysses_attention)
+                                              ulysses_attention,
+                                              ulysses_flash_attention)
+            if self.impl == "ring":
+                seq_fn = ring_attention
+            elif self.seq_inner_impl == "fast":
+                seq_fn = ulysses_flash_attention
+            else:
+                seq_fn = ulysses_attention
             ctx = seq_fn(q, k, v, axis_name=self.seq_parallel_axis,
                          causal=self.causal, scale=1.0)
             bias = None

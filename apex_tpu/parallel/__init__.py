@@ -20,7 +20,8 @@ from .mesh import (
 )
 from .distributed import DistributedDataParallel, Reducer, allreduce_tree
 from .sync_batchnorm import SyncBatchNorm, sync_batch_norm, batch_norm_stats
-from .sequence import ring_attention, ulysses_attention
+from .sequence import (ring_attention, ulysses_attention,
+                       ulysses_flash_attention)
 from .expert import MoELayer, moe_ffn
 from .pipeline import pipeline_apply, stack_stage_params, unstack_local
 from .LARC import LARC

@@ -127,6 +127,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     if attn_fn is not None:
         out = attn_fn(qh * scale, kh, vh, causal=causal)
+        # (attn_fn contract: q arrives pre-scaled, returns (B, H/n, S, D))
     else:
         s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32) * scale,
                        kh.astype(jnp.float32))
@@ -138,3 +139,31 @@ def ulysses_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
     return to_seq(out.astype(q.dtype))
+
+
+def ulysses_flash_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
+                            causal: bool = False,
+                            scale: Optional[float] = None):
+    """Ulysses with the Pallas flash kernel on the gathered-sequence leg.
+
+    After the all_to_all each device holds its head group at FULL sequence
+    length — exactly the aligned layout the flash kernel wants (causal
+    block-skipping included, online softmax, O(S) attention memory).  This
+    is the long-context composition: all_to_all re-shard + flash core,
+    with gradients flowing through the kernel's custom VJP and the linear
+    all_to_alls.  Contrast ``ring_attention``, whose cross-device
+    online-softmax already never materializes the score matrix."""
+    from ..contrib.multihead_attn.flash import flash_attention
+
+    def attn_fn(qh, kh, vh, causal):
+        B, Hl, S, D = qh.shape
+        Sk = kh.shape[2]           # cross-attention: kv length may differ
+        bias = jnp.zeros((1, 1, Sk), jnp.float32)
+        out = flash_attention(qh.reshape(B * Hl, S, D),
+                              kh.reshape(B * Hl, Sk, D),
+                              vh.reshape(B * Hl, Sk, D),
+                              bias, causal=causal, heads=Hl)
+        return out.reshape(B, Hl, S, D)
+
+    return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal,
+                             scale=scale, attn_fn=attn_fn)

@@ -89,6 +89,72 @@ def test_gradients_match_single_device(fn):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_matches_single_device(causal):
+    """ulysses_flash_attention (all_to_all re-shard + Pallas flash core)
+    == full single-device attention, fwd and bwd.  check_vma=False: the
+    pallas interpreter's grid-loop carry is untyped (the documented jax
+    limitation); compiled TPU pallas is unaffected."""
+    from apex_tpu.parallel.sequence import ulysses_flash_attention
+    q, k, v = _qkv(3)
+    g = jax.random.normal(jax.random.PRNGKey(7), (B, H, S, D))
+    mesh = _mesh()
+    spec = P(None, None, "seq", None)
+
+    @jax.jit
+    def dist(q, k, v):
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec,
+                           check_vma=False)
+        def apply(q, k, v):
+            return ulysses_flash_attention(q, k, v, axis_name="seq",
+                                           causal=causal)
+        out = apply(q, k, v)
+        grads = jax.grad(lambda q_, k_, v_: jnp.sum(apply(q_, k_, v_) * g),
+                         argnums=(0, 1, 2))(q, k, v)
+        return out, grads
+
+    out, grads = dist(q, k, v)
+    ref = reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+    ref_grads = jax.jit(jax.grad(
+        lambda q_, k_, v_: jnp.sum(reference_attention(q_, k_, v_, causal)
+                                   * g), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_self_mha_ulysses_fast_inner_matches_default():
+    """SelfMultiheadAttn(impl='ulysses', seq_inner_impl='fast') == the
+    jnp inner core, through the module path."""
+    from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+    E, HEADS = 32, 8
+    T, BB = 64, 2
+    outs = {}
+    for inner in ("default", "fast"):
+        mha = SelfMultiheadAttn(E, HEADS, impl="ulysses", causal=True,
+                                seq_inner_impl=inner)
+        params = mha.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (T, BB, E))
+        mesh = _mesh()
+        spec = P("seq", None, None)
+        rep = jax.tree_util.tree_map(lambda _: P(), params)
+
+        @jax.jit
+        @functools.partial(shard_map, mesh=mesh, in_specs=(rep, spec),
+                           out_specs=spec, check_vma=False)
+        def apply(p, x):
+            return mha(p, x)[0]
+
+        outs[inner] = apply(params, x)
+    np.testing.assert_allclose(np.asarray(outs["fast"]),
+                               np.asarray(outs["default"]), atol=2e-4)
+
+    for other in ("ring", "default", "fast"):
+        with pytest.raises(AssertionError, match="ulysses"):
+            SelfMultiheadAttn(E, HEADS, impl=other, seq_inner_impl="fast")
+
+
 def test_ring_cross_attention_different_kv_len():
     """k/v sequence length may differ from q's (cross attention)."""
     q, _, _ = _qkv(2)
