@@ -364,7 +364,7 @@ def run(budget_left=lambda: 1e9):
 
 
 def _inner_main():
-    deadline = time.monotonic() + 540.0
+    deadline = time.monotonic() + 700.0
     print(json.dumps(run(lambda: deadline - time.monotonic())))
 
 
@@ -380,7 +380,7 @@ def main():
     if healthy:
         try:
             r = subprocess.run([sys.executable, __file__, "--inner"],
-                               capture_output=True, text=True, timeout=600)
+                               capture_output=True, text=True, timeout=780)
             sys.stderr.write(r.stderr or "")
             for line in (r.stdout or "").splitlines():
                 if line.startswith("{"):

@@ -15,9 +15,9 @@ r = p(75); print(r.detail); raise SystemExit(0 if r else 1)" 2>&1)
   rc=$?
   if [ $rc -eq 0 ]; then
     echo "$(date +%H:%M:%S) tunnel healthy — running benches" >> tpu_watch.out
-    timeout 500 python bench.py --inner > BENCH_TPU_r4.json 2>> tpu_watch.out
+    timeout 700 python bench.py --inner > BENCH_TPU_r4.json 2>> tpu_watch.out
     echo "$(date +%H:%M:%S) bench.py done rc=$?" >> tpu_watch.out
-    timeout 650 python bench_kernels.py --inner > BENCH_KERNELS_TPU_r4.json 2>> tpu_watch.out
+    timeout 860 python bench_kernels.py --inner > BENCH_KERNELS_TPU_r4.json 2>> tpu_watch.out
     echo "$(date +%H:%M:%S) bench_kernels.py done rc=$?" >> tpu_watch.out
     # marker LAST: it invites the interactive session to kill this script
     # and take the (single-client) tunnel — must not race the bench runs
