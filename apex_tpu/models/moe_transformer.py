@@ -36,6 +36,8 @@ class MoETransformerConfig:
     dtype: Any = jnp.float32
     remat: bool = False       # jax.checkpoint each layer (recompute
                               # activations + the all_to_all in backward)
+    attn_impl: str = "default"  # "fast" routes the contrib flash kernel,
+                                # same knob as TransformerConfig.attn_impl
 
     @property
     def head_dim(self):
@@ -95,8 +97,18 @@ def _moe_layer(x, lyr, cfg: MoETransformerConfig, expert_axis):
     q = qkv[:, :, 0].reshape(B, S, H, -1).transpose(0, 2, 1, 3) * scale
     k = qkv[:, :, 1].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
     v = qkv[:, :, 2].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
-    ctx = attention_core(q, k, v, jnp.zeros((1, S, S), jnp.float32),
-                         causal=cfg.causal)
+    if cfg.attn_impl == "fast":
+        from ..contrib.multihead_attn.flash import flash_attention
+        hd = cfg.head_dim
+        ctx = flash_attention(q.reshape(B * H, S, hd),
+                              k.reshape(B * H, S, hd),
+                              v.reshape(B * H, S, hd),
+                              jnp.zeros((1, 1, S), jnp.float32),
+                              causal=cfg.causal, heads=H)
+        ctx = ctx.reshape(B, H, S, hd)
+    else:
+        ctx = attention_core(q, k, v, jnp.zeros((1, S, S), jnp.float32),
+                             causal=cfg.causal)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B * S, cfg.d_model)
     x = x + (ctx.astype(dt) @ lyr["out"].astype(dt)).reshape(x.shape)
 

@@ -64,8 +64,7 @@ def parse_args(argv=None):
                    choices=("default", "fast"),
                    help="attention impl: 'fast' = the contrib flash "
                         "Pallas kernel (the reference examples' "
-                        "fast_self_multihead_attn switch); MoE keeps the "
-                        "default path")
+                        "fast_self_multihead_attn switch)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each layer (recompute activations "
                         "in backward) — O(1)-in-depth activation memory "
@@ -177,8 +176,6 @@ def main(argv=None):
     args = parse_args(argv)
     if args.moe and (args.bert_large or args.zero):
         raise SystemExit("--moe combines with the standard path only")
-    if args.moe and args.attn != "default":
-        raise SystemExit("--attn fast combines with the standard path only")
     if args.bert_large:
         cfg = bert_large_config(dtype=jnp.bfloat16, remat=args.remat,
                                 attn_impl=args.attn)
@@ -187,7 +184,8 @@ def main(argv=None):
             vocab_size=args.vocab, max_len=args.seq_len,
             num_layers=args.layers, d_model=args.d_model,
             num_heads=args.heads, d_ff=4 * args.d_model,
-            num_experts=args.moe, dtype=jnp.bfloat16, remat=args.remat)
+            num_experts=args.moe, dtype=jnp.bfloat16, remat=args.remat,
+            attn_impl=args.attn)
     else:
         cfg = TransformerConfig(
             vocab_size=args.vocab, max_len=args.seq_len,

@@ -41,6 +41,31 @@ def test_shapes_and_training():
     assert float(loss) < l0      # descends (memorizing 32 tokens)
 
 
+def test_moe_fast_attention_matches_default():
+    """attn_impl='fast' (flash kernel) == the attention_core path in the
+    MoE family — fwd + grads, causal and bidirectional."""
+    import dataclasses as dc
+    params = moe_transformer_init(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    batch = {"tokens": tokens, "targets": tokens}
+    for causal in (False, True):
+        c_def = dc.replace(CFG, causal=causal)
+        c_fast = dc.replace(CFG, causal=causal, attn_impl="fast")
+        o_def, aux_d = moe_transformer_apply(params, tokens, c_def)
+        o_fast, aux_f = moe_transformer_apply(params, tokens, c_fast)
+        np.testing.assert_allclose(np.asarray(o_fast), np.asarray(o_def),
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(float(aux_f), float(aux_d), rtol=1e-5)
+        g_def = jax.grad(lambda p: moe_transformer_loss(p, batch, c_def))(
+            params)
+        g_fast = jax.grad(lambda p: moe_transformer_loss(p, batch, c_fast))(
+            params)
+        for a, b in zip(jax.tree_util.tree_leaves(g_def),
+                        jax.tree_util.tree_leaves(g_fast)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=5e-4, rtol=5e-3)
+
+
 def test_expert_sharded_matches_single_device():
     """Sharded-expert apply inside shard_map == the single-device model
     (tokens replicated: same routing decisions, no capacity difference
