@@ -27,6 +27,7 @@ block through remote-tunnel TPU backends.
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 import gc
 import json
@@ -275,7 +276,29 @@ def bench_bert_e2e(on_tpu):
                                 vocab_size=4096, max_len=128, num_heads=4,
                                 dtype=jnp.bfloat16)
         batch, seq = 2, 64
-    _log(f"bert e2e leg: layers={cfg.num_layers} batch={batch} seq={seq}")
+    try:
+        return _bench_bert_e2e_at(on_tpu, cfg, batch, seq)
+    except Exception as err:
+        if cfg.attn_impl != "fast":
+            raise
+        # first real-hardware contact for the flash kernel (Mosaic compile
+        # of the D=64 bwd is the known risk): record the failure but keep
+        # the leg alive on the XLA attention path
+        _log(f"bert flash path failed ({repr(err)[:150]}); retrying with "
+             "attn_impl='default'")
+        gc.collect()
+        out = _bench_bert_e2e_at(
+            on_tpu, dataclasses.replace(cfg, attn_impl="default"), batch,
+            seq)
+        out["flash_error"] = repr(err)[:200]
+        return out
+
+
+def _bench_bert_e2e_at(on_tpu, cfg, batch, seq):
+    from apex_tpu import amp
+
+    _log(f"bert e2e leg: layers={cfg.num_layers} batch={batch} seq={seq} "
+         f"attn={cfg.attn_impl}")
     params = jax.jit(lambda: transformer_init(jax.random.PRNGKey(0), cfg))()
     n_params = int(sum(p.size for p in jax.tree_util.tree_leaves(params)))
     opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=1.0,
