@@ -85,14 +85,12 @@ class FP16_Optimizer:
                 "FP16_Optimizer.step(closure): gradients still non-finite "
                 "after 20 loss-scale reductions")
         if grads32 is not None:            # staged + externally clipped
-            if self._staged is not None:
-                finite = self._staged[1]
-            else:
-                # caller bypassed update_master_grads: still guard the
-                # masters — every step path must check finiteness
-                finite = _scaler.all_finite(grads32)
+            # check the tensors actually being applied, not a stale staged
+            # flag: the caller may pass grads unrelated to the last
+            # update_master_grads (the signature allows any tree), and a
+            # clip of overflowed grads stays non-finite anyway
             self._staged = None
-            return self._apply(grads32, finite)
+            return self._apply(grads32, _scaler.all_finite(grads32))
         if scaled_grads is None:           # no-arg: consume staged grads
             if self._staged is None:
                 raise RuntimeError(

@@ -97,6 +97,9 @@ def _moe_layer(x, lyr, cfg: MoETransformerConfig, expert_axis):
     q = qkv[:, :, 0].reshape(B, S, H, -1).transpose(0, 2, 1, 3) * scale
     k = qkv[:, :, 1].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
     v = qkv[:, :, 2].reshape(B, S, H, -1).transpose(0, 2, 1, 3)
+    if cfg.attn_impl not in ("default", "fast"):
+        raise ValueError(
+            f"attn_impl must be 'default' or 'fast', got {cfg.attn_impl!r}")
     if cfg.attn_impl == "fast":
         from ..contrib.multihead_attn.flash import flash_attention
         hd = cfg.head_dim

@@ -162,7 +162,11 @@ def _roofline(jitted, args, step_s, on_tpu):
         return {}
     try:
         from apex_tpu.pyprof.prof import _first
-        ca = jitted.lower(*args).compile().cost_analysis()
+        # Lowered.cost_analysis() runs on the HLO without a backend
+        # compile — .compile() here would re-compile the just-timed step
+        # from scratch (lower().compile() bypasses the jit executable
+        # cache) and could blow the inner bench deadline
+        ca = jitted.lower(*args).cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         out = {}

@@ -96,7 +96,13 @@ def run_standard(args, cfg, mesh):
                            verbosity=0)
     sharding = NamedSharding(mesh, P("data"))
 
-    @jax.jit
+    # donate the amp state: the flat fused engine writes fresh master/m/v
+    # buffers (no in-kernel aliasing, PERF_NOTES §2), so in-place HBM
+    # reuse must happen here at the jit boundary — at BERT-large scale
+    # the un-donated transient would be an extra ~4 GB of flat fp32
+    # state.  Safe: amp.initialize never aliases buffers between the
+    # model and master trees for this param family.
+    @functools.partial(jax.jit, donate_argnums=0)
     def train_step(state, batch):
         def loss_fn(p):
             loss = loss_impl(p, batch, cfg)

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""TPU tunnel doctor — one command for the docs/tpu_tunnel.md runbook.
+
+Runs every locally-actionable diagnostic in order and prints a verdict:
+
+1. leaked-client scan: any local process holding a connection to the
+   relay (the ONLY locally-fixable wedge cause — kill it and re-probe);
+2. relay TCP fingerprint: connect to 127.0.0.1:2024 and classify
+   (refused / accept-then-EOF / banner) — accept-then-EOF means the
+   relay's upstream is gone and no client-side action can help;
+3. subprocess health probe (`probe_ambient_backend`) with failure detail;
+4. watcher status (tpu_watch.sh running? last log lines).
+
+Exit code 0 iff the tunnel is healthy.  Never dials the tunnel
+in-process (a wedged dial blocks in C++ and cannot be interrupted).
+
+Usage:  python tools/tpu_doctor.py [--probe-timeout 75]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+RELAY = ("127.0.0.1", 2024)
+
+
+def leaked_clients():
+    """PIDs with an established connection to the relay (via /proc)."""
+    out = subprocess.run(["ss", "-tnp"], capture_output=True, text=True)
+    hits = []
+    for line in (out.stdout or "").splitlines():
+        if f"{RELAY[0]}:{RELAY[1]}" in line and "ESTAB" in line:
+            hits.append(line.strip())
+    return hits
+
+
+def relay_fingerprint():
+    try:
+        s = socket.create_connection(RELAY, timeout=3)
+    except OSError as e:
+        return "refused", f"TCP connect failed: {e}"
+    try:
+        s.settimeout(2)
+        try:
+            data = s.recv(256)
+        except socket.timeout:
+            return "open-silent", "TCP open, no banner within 2s (normal " \
+                                  "for a healthy relay awaiting a dial)"
+        if data:
+            return "banner", f"unexpected banner: {data[:60]!r}"
+        return "eof", ("relay accepted then immediately closed — its "
+                       "upstream/backend is gone; NO client-side action "
+                       "can recover this, wait for the remote end")
+    finally:
+        s.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    args = ap.parse_args(argv)
+
+    print("== 1. leaked local clients holding the relay ==")
+    leaks = leaked_clients()
+    if leaks:
+        for l in leaks:
+            print("  LEAK:", l)
+        print("  -> kill the owning pid(s), then re-run; this is the only "
+              "locally-fixable wedge cause")
+    else:
+        print("  none (the single-client slot is not held from this box)")
+
+    print("== 2. relay TCP fingerprint ==")
+    kind, detail = relay_fingerprint()
+    print(f"  {kind}: {detail}")
+
+    print("== 3. subprocess health probe ==")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from apex_tpu.utils.platform import probe_ambient_backend
+    r = probe_ambient_backend(args.probe_timeout)
+    print(f"  {'HEALTHY' if r else 'WEDGED'}: {r.detail}")
+
+    print("== 4. watcher ==")
+    w = subprocess.run(["pgrep", "-f", "tpu_watch[.]sh"],
+                       capture_output=True, text=True)
+    pids = (w.stdout or "").split()
+    print(f"  tpu_watch.sh: {'running pid ' + ','.join(pids) if pids else 'NOT running'}")
+    log = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tpu_watch.out")
+    if os.path.exists(log):
+        with open(log) as f:
+            tail = f.readlines()[-3:]
+        for line in tail:
+            print("   ", line.rstrip())
+
+    if bool(r):
+        print("VERDICT: healthy — one client at a time; stop the watcher "
+              "before taking the chip interactively")
+        return 0
+    print("VERDICT: wedged — "
+          + ("kill the leaked client above and re-run"
+             if leaks else "no local cause; the watcher owns recovery"))
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
