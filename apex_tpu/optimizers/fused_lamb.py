@@ -135,9 +135,16 @@ class FusedLAMB(FusedOptimizer):
         b1, b2, eps = self.beta1, self.beta2, self.eps
         beta3 = 1.0 - b1 if self.grad_averaging else 1.0
 
-        g = flat_grads.astype(jnp.float32) * inv_scale
-        gnorm = kernels.multi_tensor_l2norm(g)
-        g = g * self._clip_coeff(gnorm)
+        # l2norm is homogeneous (||c*x|| = c*||x||, inv_scale > 0): norm
+        # the RAW grads (the kernel reads them in their original dtype —
+        # half the bandwidth for bf16 grads) and fold unscale+clip into
+        # ONE scalar applied inside the stage-1 fusion.  vs the round-3
+        # form (materialize g = grads*inv_scale, then kernel-read it)
+        # this saves a full write+read of the flat buffer per step
+        # (~2.7 GB at 334M params).
+        gnorm = kernels.multi_tensor_l2norm(flat_grads) * inv_scale
+        g = flat_grads.astype(jnp.float32) * (
+            inv_scale * self._clip_coeff(gnorm))
         p = state.master
         if not self.adam_w_mode:
             g = g + wd * p

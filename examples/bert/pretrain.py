@@ -142,7 +142,10 @@ def run_zero(args, cfg, mesh):
     opt_state = jax.jit(init_fn)(params)
     n_dev = mesh.devices.size
 
-    @jax.jit
+    # donate the (params, sharded opt state) carry: the stage-1 kernels
+    # write fresh buffers (PERF_NOTES §2), so in-place HBM reuse happens
+    # at this jit boundary
+    @functools.partial(jax.jit, donate_argnums=0)
     def train_step(carry, batch):
         params, opt_state = carry
 
