@@ -75,15 +75,20 @@ def fused_dense_act(x, w, b=None, activation="relu", *, block_m=256,
     Np = wp.shape[1]
     grid = (Mp // block_m, Np // block_n, Kp // block_k)
 
+    # memory_space pinned on every spec: an unpinned BlockSpec may default
+    # to HBM (pallas guide, pitfall 1)
     in_specs = [
-        pl.BlockSpec((block_m, block_k), lambda mi, ni, ki: (mi, ki)),
-        pl.BlockSpec((block_k, block_n), lambda mi, ni, ki: (ki, ni)),
+        pl.BlockSpec((block_m, block_k), lambda mi, ni, ki: (mi, ki),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_k, block_n), lambda mi, ni, ki: (ki, ni),
+                     memory_space=pltpu.VMEM),
     ]
     ins = [xp, wp]
     has_bias = b is not None
     if has_bias:
         in_specs.append(pl.BlockSpec((1, block_n),
-                                     lambda mi, ni, ki: (0, ni)))
+                                     lambda mi, ni, ki: (0, ni),
+                                     memory_space=pltpu.VMEM))
         ins.append(_pad_to(b.reshape(1, N), block_n, 1))
 
     out = pl.pallas_call(
@@ -91,7 +96,8 @@ def fused_dense_act(x, w, b=None, activation="relu", *, block_m=256,
         grid=grid,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((block_m, block_n),
-                               lambda mi, ni, ki: (mi, ni)),
+                               lambda mi, ni, ki: (mi, ni),
+                               memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Mp, Np), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
         compiler_params=pltpu.CompilerParams(

@@ -84,15 +84,20 @@ def _bwd_kernel(affine, g_ref, x_ref, mean_ref, invvar_ref, *refs):
 
 
 def _row_spec(br):
-    return pl.BlockSpec((br, 1), lambda i: (i, 0))
+    # memory_space pinned: an unpinned BlockSpec may default to HBM and
+    # stream per-element (pallas guide, pitfall 1)
+    return pl.BlockSpec((br, 1), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
 
 
 def _full_spec(br, h):
-    return pl.BlockSpec((br, h), lambda i: (i, 0))
+    return pl.BlockSpec((br, h), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
 
 
 def _param_spec(h):
-    return pl.BlockSpec((1, h), lambda i: (0, 0))
+    return pl.BlockSpec((1, h), lambda i: (0, 0),
+                        memory_space=pltpu.VMEM)
 
 
 def _pad_rows(x2d, br):
