@@ -117,7 +117,23 @@ def save_sharded(path: str, tree) -> None:
     directories and no directory would ever hold a complete checkpoint).
     Filesystem mutations of the shared ``path`` (stale-tmp cleanup and
     the final swap) run on process 0 only, fenced by global barriers so
-    no process races ahead of the swap."""
+    no process races ahead of the swap.
+
+    Because the temp dir name is shared, concurrent *independent* jobs
+    saving to the same ``path`` are unsupported: each would treat the
+    other's live temp dir as its own stale leftover.  The stale-tmp
+    cleanup is age-gated (only dirs untouched for >60s are removed) as a
+    guard against deleting a live peer's write, but that is a heuristic,
+    not a coordination mechanism — give independent jobs distinct paths.
+
+    Failure coverage: the ok-flag allgather below turns a rank that
+    *raises* during the save phase into a clean collective failure (all
+    ranks raise together).  It cannot cover a rank that dies without
+    raising — SIGKILL, machine loss, or a failure inside orbax's own
+    internal sync points — which leaves peers blocked in ``ckptr.save``
+    / ``process_allgather`` until the distributed runtime's own timeout.
+    Multi-host jobs should run under a job-level watchdog (the posture
+    of the reference's launcher) to bound that residual hang window."""
     import shutil
 
     import orbax.checkpoint as ocp
@@ -141,8 +157,29 @@ def save_sharded(path: str, tree) -> None:
             os.rename(f"{path}.old", path)
         if os.path.exists(tmp):
             # leftover from a previous preempted save; remove before the
-            # collective write so force=True semantics stay orbax-internal
-            shutil.rmtree(tmp, ignore_errors=True)
+            # collective write so force=True semantics stay orbax-internal.
+            # Age-gated: a tmp written to in the last minute may be a live
+            # collective write from a concurrent independent job (an
+            # unsupported layout — see docstring) — leave a fresh one to
+            # orbax's own force handling rather than rmtree a live write.
+            # "Written to" means the newest mtime ANYWHERE under the tree:
+            # orbax streams shards into subdirectories, so the top-level
+            # dir's mtime goes quiet seconds into a long live save.
+            import time as _time
+            newest = 0.0
+            try:
+                newest = os.path.getmtime(tmp)
+                for root, _dirs, files in os.walk(tmp):
+                    for ent in files:
+                        try:
+                            newest = max(newest, os.path.getmtime(
+                                os.path.join(root, ent)))
+                        except OSError:
+                            pass
+            except OSError:
+                pass
+            if newest == 0.0 or _time.time() - newest > 60.0:
+                shutil.rmtree(tmp, ignore_errors=True)
     _barrier("pre_save")
     # capture a save-phase failure instead of raising past the collective:
     # a process that raises before the sync point strands its peers in the

@@ -86,6 +86,17 @@ class TorchFusedOptimizer:
         tree = [from_torch(p.data) for p in self._params]
         self._jax_params = tree
         self._state = optimizer.init(tree)
+        # one compiled executable per (path, lr-passed) combination; an
+        # eager step dispatches every elementwise op separately and was
+        # measured 2-3x slower than the jitted fusion (tools/bench_interop)
+        self._jit_cache = {}
+        # persistent packed-path staging buffers (allocated on first
+        # packed step): a fresh 0-init alloc per step costs ~5x the
+        # memcpys in page faults (host_pack.pack docstring)
+        self._stage_g = None
+        self._stage_p = None
+        self._xfer_g = None
+        self._xfer_p = None
 
     # -- reference-shaped API -------------------------------------------------
 
@@ -110,8 +121,25 @@ class TorchFusedOptimizer:
                 gs.append(p.grad)
         else:
             gs = list(grads)
+        # route a plain-float optimizer lr through the traced lr argument:
+        # the torch scheduler idiom (opt.optimizer.lr = sched(step) before
+        # every step) then updates a traced scalar instead of recompiling
+        # per value (hyperparameter changes OTHER than lr still retrace —
+        # see _jitted)
+        if lr is None and isinstance(self.optimizer.lr, (int, float)):
+            lr = float(self.optimizer.lr)
         if self._native_fast_path_ok(gs):
             return self._step_packed(gs, scale, lr)
+        # known slow path: warn once (codebase convention, scaler.py:43-45)
+        # instead of silently re-reading every param host-side each step
+        from ..utils.logging import warn_once
+        warn_once(
+            "interop_slow_path",
+            "apex_tpu.interop: using the per-leaf copy path — every step "
+            "copies all grads AND re-reads all params host-side.  The "
+            "packed fast path needs a flat fused-impl optimizer and "
+            "contiguous CPU fp32 torch params+grads (bf16 or non-CPU "
+            "tensors fall back).  Measured costs: docs/interop.md.")
         # COPY on import (not zero-copy): the torch side keeps mutating
         # these buffers (zero_grad, in-place ops) while async-dispatched JAX
         # computations may still be reading them — an alias here silently
@@ -127,13 +155,76 @@ class TorchFusedOptimizer:
             self._state = self._state._replace(
                 master=self.optimizer.flattener.flatten(ptree))
         self._jax_params = ptree
-        new_params, self._state = self.optimizer.step(
-            self._state, gtree, self._jax_params, scale=scale, lr=lr)
+        if lr is None or isinstance(lr, (int, float)):
+            fn = self._jitted("tree", lr is not None)
+            args = (self._state, gtree, self._jax_params,
+                    jnp.float32(scale))
+            if lr is not None:
+                args += (jnp.float32(lr),)
+            new_params, self._state = fn(*args)
+        else:                          # schedule callables stay eager
+            new_params, self._state = self.optimizer.step(
+                self._state, gtree, self._jax_params, scale=scale, lr=lr)
         self._jax_params = new_params
         with torch.no_grad():
             for p, new in zip(self._params, new_params):
                 p.data.copy_(to_torch(new))
         return None
+
+    def _jitted(self, kind, has_lr):
+        """Cached jitted step executables.  ``scale`` (and a float ``lr``)
+        are passed as traced scalars so per-step value changes (dynamic
+        loss scale, lr schedules driven torch-side) never retrace.
+
+        Every scalar hyperparameter of the optimizer EXCEPT ``lr`` is
+        part of the cache key: ``step_flat`` reads them off ``self`` at
+        trace time, so a torch-style in-place mutation (``opt.optimizer
+        .weight_decay = 0`` between steps, honored by the pre-jit eager
+        path) must invalidate the executable rather than be silently
+        ignored.  ``lr`` is excluded because step() routes a float lr
+        through the traced argument — the per-step scheduler idiom must
+        NOT recompile per value.  The cache is bounded: per-step
+        mutation of a keyed hyperparameter degrades to retrace-per-step
+        (correct, slow) without also growing memory per step."""
+        hypers = tuple(sorted(
+            (k, v) for k, v in vars(self.optimizer).items()
+            if isinstance(v, (int, float, bool, str, tuple))
+            and k != "lr"))
+        key = (kind, has_lr, hypers)
+        if key not in self._jit_cache and len(self._jit_cache) >= 16:
+            self._jit_cache.pop(next(iter(self._jit_cache)))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            opt = self.optimizer
+            if kind == "flat":
+                # donate the jax-owned state (m/v/count): those buffers
+                # are dead after the step (self._state is overwritten),
+                # and donation updates them in place instead of
+                # allocating fresh tens-of-MB outputs per step.  The
+                # master is passed SEPARATELY and not donated — it
+                # aliases the host transfer buffer (asarray zero-copy),
+                # and donating externally-backed memory would force a
+                # hidden defensive copy.
+                if has_lr:
+                    fn = jax.jit(
+                        lambda rest, master, g, sc, lr: opt.step_flat(
+                            rest._replace(master=master), g, scale=sc,
+                            lr=lr),
+                        donate_argnums=(0,))
+                else:
+                    fn = jax.jit(
+                        lambda rest, master, g, sc: opt.step_flat(
+                            rest._replace(master=master), g, scale=sc),
+                        donate_argnums=(0,))
+            else:
+                if has_lr:
+                    fn = jax.jit(lambda s, g, p, sc, lr: opt.step(
+                        s, g, p, scale=sc, lr=lr))
+                else:
+                    fn = jax.jit(lambda s, g, p, sc: opt.step(
+                        s, g, p, scale=sc))
+            self._jit_cache[key] = fn
+        return fn
 
     # -- native packed fast path ---------------------------------------------
 
@@ -156,10 +247,42 @@ class TorchFusedOptimizer:
         fl = self.optimizer.flattener
         g_np = [g.detach().numpy() for g in gs]
         p_np = [p.detach().numpy() for p in self._params]
-        flat_g = jnp.asarray(host_pack.pack_like_flattener(g_np, fl))
-        flat_p = jnp.asarray(host_pack.pack_like_flattener(p_np, fl))
-        self._state = self.optimizer.step_flat(
-            self._state._replace(master=flat_p), flat_g, scale=scale, lr=lr)
+        if self._stage_g is None:
+            self._stage_g = np.zeros((fl.total,), np.float32)
+            self._stage_p = np.zeros((fl.total,), np.float32)
+            self._xfer_g = np.zeros((fl.total,), np.float32)
+            self._xfer_p = np.zeros((fl.total,), np.float32)
+        # two-buffer hand-off per operand:
+        #   _stage_*  — pack target; jax never sees it, so its padding
+        #               gaps stay the zeros the flat math depends on
+        #               (l2norm/overflow reduces run over the FULL flat
+        #               buffer, gaps included);
+        #   _xfer_*   — whole-buffer copyto from _stage_*, then handed to
+        #               jax via asarray (zero-copy alias on CPU; the H2D
+        #               transfer on TPU).  Overwriting it next step is
+        #               safe: the step below synchronizes (device_get)
+        #               before returning, and the whole-buffer copyto
+        #               restores pristine gaps even if XLA scribbled the
+        #               donated buffer.
+        # jnp.array(copy=True) instead measured 56 ms per 42 MB operand —
+        # slower than the entire donated step (tools/bench_interop).
+        host_pack.pack_like_flattener(g_np, fl, out=self._stage_g)
+        host_pack.pack_like_flattener(p_np, fl, out=self._stage_p)
+        np.copyto(self._xfer_g, self._stage_g)
+        np.copyto(self._xfer_p, self._stage_p)
+        flat_g = jnp.asarray(self._xfer_g)
+        flat_p = jnp.asarray(self._xfer_p)
+        if lr is None or isinstance(lr, (int, float)):
+            fn = self._jitted("flat", lr is not None)
+            args = (self._state._replace(master=None), flat_p, flat_g,
+                    jnp.float32(scale))
+            if lr is not None:
+                args += (jnp.float32(lr),)
+            self._state = fn(*args)
+        else:                          # schedule callables stay eager
+            self._state = self.optimizer.step_flat(
+                self._state._replace(master=flat_p), flat_g, scale=scale,
+                lr=lr)
         out = np.asarray(jax.device_get(self._state.master))
         with torch.no_grad():
             host_pack.unpack(out, [p.data.numpy() for p in self._params],

@@ -38,6 +38,8 @@ class MoETransformerConfig:
                               # activations + the all_to_all in backward)
     attn_impl: str = "default"  # "fast" routes the contrib flash kernel,
                                 # same knob as TransformerConfig.attn_impl
+    xent_impl: str = "auto"     # loss kernel choice, same knob as
+                                # TransformerConfig.xent_impl
 
     @property
     def head_dim(self):
@@ -170,7 +172,8 @@ def moe_transformer_loss(params, batch, cfg: MoETransformerConfig, *,
     B, S, V = logits.shape
     nll = softmax_xentropy_loss(logits.reshape(B * S, V),
                                 batch["targets"].reshape(B * S),
-                                0.0, -1).reshape(B, S)
+                                0.0, -1, False,
+                                cfg.xent_impl).reshape(B, S)
     w = batch.get("weights")
     if w is None:
         mlm = nll.mean()

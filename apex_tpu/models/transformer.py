@@ -52,6 +52,12 @@ class TransformerConfig:
                                   # online softmax) — the analog of
                                   # running the reference's examples with
                                   # fast_*_multihead_attn extensions
+    xent_impl: str = "auto"       # loss kernel: "auto" (pallas on TPU,
+                                  # xla elsewhere) / "pallas" / "xla".
+                                  # Explicit so harnesses can pin the XLA
+                                  # path per-config instead of mutating
+                                  # APEX_TPU_XENT_IMPL (trace-time env
+                                  # reads don't survive retraces)
 
     @property
     def head_dim(self) -> int:
@@ -262,7 +268,8 @@ def transformer_loss(params, batch, cfg: TransformerConfig, *,
     # is a legitimate target here (unlike the reference's seq2seq pad=0)
     nll = softmax_xentropy_loss(logits.reshape(B * S, V),
                                 batch["targets"].reshape(B * S),
-                                smoothing, -1).reshape(B, S)
+                                smoothing, -1, False,
+                                cfg.xent_impl).reshape(B, S)
     w = batch.get("weights")
     if w is None:
         return nll.mean()

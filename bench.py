@@ -288,18 +288,17 @@ def bench_bert_e2e(on_tpu):
         # first real-hardware contact for the Pallas kernels (Mosaic
         # compile of the D=64 flash bwd / the xentropy kernel are the
         # known risks): record the failure but keep the leg alive on the
-        # all-XLA path (default attention + APEX_TPU_XENT_IMPL=xla)
-        import os
+        # all-XLA path.  The impl choice rides the CONFIG (xent_impl),
+        # not a temporary env mutation — APEX_TPU_XENT_IMPL is read at
+        # trace time, so a popped env var would silently flip later
+        # retraces back to pallas (ADVICE r4).
         _log(f"bert pallas path failed ({repr(err)[:150]}); retrying "
              "all-XLA (attn default, xentropy xla)")
         gc.collect()
-        os.environ["APEX_TPU_XENT_IMPL"] = "xla"
-        try:
-            out = _bench_bert_e2e_at(
-                on_tpu, dataclasses.replace(cfg, attn_impl="default"),
-                batch, seq)
-        finally:
-            os.environ.pop("APEX_TPU_XENT_IMPL", None)
+        out = _bench_bert_e2e_at(
+            on_tpu, dataclasses.replace(cfg, attn_impl="default",
+                                        xent_impl="xla"),
+            batch, seq)
         out["pallas_error"] = repr(err)[:200]
         return out
 
@@ -349,7 +348,8 @@ def _bench_bert_e2e_at(on_tpu, cfg, batch, seq):
     _log(f"bert e2e: {ms:.1f} ms/step, {seq_per_s:.2f} sequences/sec")
     out = {"step_ms": round(ms, 2), "sequences_per_sec": round(seq_per_s, 2),
            "batch": batch, "seq": seq, "layers": cfg.num_layers,
-           "attn_impl": cfg.attn_impl, "remat": cfg.remat,
+           "attn_impl": cfg.attn_impl, "xent_impl": cfg.xent_impl,
+           "remat": cfg.remat,
            "model": ("bert-large-24L-flash-remat" if on_tpu
                      else "bert-tiny-cpu"),
            "n_params": n_params}
@@ -357,7 +357,10 @@ def _bench_bert_e2e_at(on_tpu, cfg, batch, seq):
     return out
 
 
-def run_bench(budget_left=lambda: 1e9):
+def run_bench(budget_left=lambda: 1e9, legs_dir=None):
+    from apex_tpu.utils.bench_legs import make_flusher
+    flush = make_flusher(legs_dir)
+
     on_tpu = jax.default_backend() == "tpu"
     _log(f"backend={jax.default_backend()} devices={len(jax.devices())}")
     cfg = bert_large_config() if on_tpu else bert_large_config(
@@ -371,11 +374,26 @@ def run_bench(budget_left=lambda: 1e9):
     n_params = int(sum(p.size for p in jax.tree_util.tree_leaves(params)))
     del params
 
+    # headline A/B flushes after EVERY sub-measurement: a tunnel that
+    # re-wedges between the xla and fused timings still leaves the xla
+    # number on disk (round-4 verdict item 2 — recovery windows must be
+    # incremental, a 3-minute window settles what it can).  merge=True:
+    # a re-run that wedges EARLIER than a previous window did must not
+    # destroy that window's already-captured timings (no flush before
+    # the first measurement, for the same reason).
+    head = {"n_params": n_params, "complete": False}
     xla_ms = time_apex_xla(make_params, grads)
+    head["xla_impl_ms"] = round(xla_ms, 3)
+    flush("headline", head, merge=True)
     fused_ms = time_apex_fused_flat(make_params, grads)
+    head["fused_flat_impl_ms"] = round(fused_ms, 3)
+    flush("headline", head, merge=True)
     fused_bf16_ms = time_apex_fused_flat(make_params, grads,
                                          grad_dtype=jnp.bfloat16)
+    head["fused_flat_bf16grads_ms"] = round(fused_bf16_ms, 3)
+    flush("headline", head, merge=True)
     base_ms = time_optax(make_params, grads)
+    head["optax_baseline_ms"] = round(base_ms, 3)
     del grads
     gc.collect()
     # headline stays apples-to-apples with the fp32-grads optax baseline;
@@ -383,14 +401,13 @@ def run_bench(budget_left=lambda: 1e9):
     # never hidden inside `value`
     best_ms = min(xla_ms, fused_ms)
     winner = "fused_flat" if fused_ms <= xla_ms else "xla"
+    head["winner"] = winner
+    head["complete"] = True
+    flush("headline", head, merge=True)
 
-    detail = {"optax_baseline_ms": round(base_ms, 3),
-              "xla_impl_ms": round(xla_ms, 3),
-              "fused_flat_impl_ms": round(fused_ms, 3),
-              "fused_flat_bf16grads_ms": round(fused_bf16_ms, 3),
-              "winner": winner,
-              "backend": jax.default_backend(),
-              "n_params": n_params}
+    detail = dict(head)
+    detail.pop("complete")
+    detail["backend"] = jax.default_backend()
 
     # honesty (round-3 verdict item 8): the CPU fallback downsizes to
     # resnet18 — record it under its OWN key so no reader mistakes the
@@ -401,6 +418,7 @@ def run_bench(budget_left=lambda: 1e9):
             detail[rn50_key] = bench_rn50(on_tpu)
         except Exception as err:
             detail[rn50_key] = {"error": repr(err)[:200]}
+        flush(rn50_key, detail[rn50_key])
     else:
         _log("skipping rn50 leg (budget)")
     gc.collect()
@@ -409,6 +427,7 @@ def run_bench(budget_left=lambda: 1e9):
             detail["bert_e2e"] = bench_bert_e2e(on_tpu)
         except Exception as err:
             detail["bert_e2e"] = {"error": repr(err)[:200]}
+        flush("bert_e2e", detail["bert_e2e"])
     else:
         _log("skipping bert e2e leg (budget)")
 
@@ -420,21 +439,34 @@ def run_bench(budget_left=lambda: 1e9):
             7 * 4 * n_params / (best_ms / 1e3) / 1e9, 1)
         detail["hbm_roofline_gbps"] = V5E_PEAK_BYTES / 1e9
 
+    # vs_baseline from a CPU fallback says nothing about the product
+    # thesis (round-4 verdict weak #3): emit null at top level so a
+    # driver skim can't over-credit a proxy ratio; the CPU ratio stays
+    # available — explicitly labelled — in the detail
+    vs = round(base_ms / best_ms, 3)
+    if not on_tpu:
+        detail["vs_baseline_cpu_proxy"] = vs
+
     return {
         "metric": "fused_lamb_step_ms_bert_large",
         "value": round(best_ms, 3),
         "unit": "ms",
-        "vs_baseline": round(base_ms / best_ms, 3),
+        "vs_baseline": vs if on_tpu else None,
         "backend": jax.default_backend(),
         "detail": detail,
     }
 
 
-def _inner_main():
+from apex_tpu.utils.bench_legs import argval as _argval
+
+
+def _inner_main(legs_dir=None):
     """Run the benchmark on the AMBIENT backend and print the JSON line.
-    Raises/hangs are the outer process's problem — that is the point."""
+    Raises/hangs are the outer process's problem — that is the point;
+    with ``legs_dir`` every completed leg survives on disk regardless."""
     deadline = time.monotonic() + 540.0
-    print(json.dumps(run_bench(lambda: deadline - time.monotonic())))
+    print(json.dumps(run_bench(lambda: deadline - time.monotonic(),
+                               legs_dir=legs_dir)))
 
 
 def main():
@@ -449,6 +481,7 @@ def main():
     """
     import subprocess
 
+    legs_dir = _argval(sys.argv, "--legs-dir")
     deadline = time.monotonic() + 620.0   # > inner's 540s budget, and the
     # CPU fallback below has its own 240s window if the inner dies early
     attempt_errs = []
@@ -468,10 +501,12 @@ def main():
         if budget < 60:
             break
         t0 = time.monotonic()
+        cmd = [sys.executable, __file__, "--inner"]
+        if legs_dir:
+            cmd += ["--legs-dir", legs_dir]
         try:
-            r = subprocess.run(
-                [sys.executable, __file__, "--inner"],
-                capture_output=True, text=True, timeout=budget)
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=budget)
         except subprocess.TimeoutExpired:
             attempt_errs.append("inner timeout")
             break                          # a hang won't improve on retry
@@ -493,10 +528,18 @@ def main():
         # top level (round-3 verdict item 8): a CPU stand-in must be
         # distinguishable from a TPU number at a glance
         payload["ambient_error"] = "; ".join(attempt_errs)[:300]
+        # a TPU inner that died MID-RUN may still have flushed completed
+        # legs — surface them (they are the real perf story; the CPU
+        # numbers above are only the well-formedness fallback)
+        if legs_dir:
+            from apex_tpu.utils.bench_legs import read_tpu_legs
+            tpu_legs = read_tpu_legs(legs_dir)
+            if tpu_legs:
+                payload["tpu_partial_legs"] = tpu_legs
     except Exception as err:               # last resort: still emit the line
         payload = {
             "metric": "fused_lamb_step_ms_bert_large",
-            "value": -1.0, "unit": "ms", "vs_baseline": 0.0,
+            "value": -1.0, "unit": "ms", "vs_baseline": None,
             "backend": "none",
             "ambient_error": "; ".join(attempt_errs)[:300],
             "detail": {"error": repr(err)[:300]},
@@ -506,6 +549,6 @@ def main():
 
 if __name__ == "__main__":
     if "--inner" in sys.argv:
-        _inner_main()
+        _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:
         main()

@@ -117,7 +117,7 @@ def bench_attention(results, on_tpu):
     results["flash_attn_fwdbwd"]["shape"] = f"B{B} H{H} S{S} D{D} causal"
 
 
-def bench_attn_seq_sweep(results, on_tpu):
+def bench_attn_seq_sweep(results, on_tpu, flush=lambda *a: None):
     """fast-vs-default fwd+bwd across sequence lengths 64..2048 — the
     analog of the reference's perf_test_multihead_attn sweep
     (apex/contrib/examples/multihead_attn/perf_test_multihead_attn.py,
@@ -152,11 +152,18 @@ def bench_attn_seq_sweep(results, on_tpu):
 
         sweep[str(S)] = ab(f"attn_seq_{S}", jax.jit(fast_fb),
                            jax.jit(default_fb), q, k, v)
-    results["attn_seq_sweep"] = {"shape": f"B{B} H{H} D{D} fwd+bwd(dq)",
-                                 "by_seq": sweep}
+        results["attn_seq_sweep"] = {"shape": f"B{B} H{H} D{D} fwd+bwd(dq)",
+                                     "by_seq": dict(sweep)}
+        # flush after every seq length: a mid-sweep wedge keeps the
+        # completed rows (round-4 verdict item 2).  Wrapped under the
+        # result key so assemble() merges section and intra-leg flushes
+        # identically; merge=True deep-merges by_seq so a re-run that
+        # wedges earlier than a previous window keeps that window's rows.
+        flush("attn_seq_sweep", {"attn_seq_sweep": results["attn_seq_sweep"]},
+              merge=True)
 
 
-def bench_flash_autotune(results, on_tpu):
+def bench_flash_autotune(results, on_tpu, flush=lambda *a: None):
     """Sweep flash block sizes on the chip; the winner is what a user pins
     via APEX_TPU_FLASH_BLOCK_Q/_K (flash.py honors them at trace time).
     Skipped on CPU — interpret-mode timings would pick nonsense."""
@@ -184,12 +191,14 @@ def bench_flash_autotune(results, on_tpu):
         except Exception as err:       # a config may not compile at this D
             sweep[f"{bq}x{bk}"] = f"failed: {repr(err)[:80]}"
         gc.collect()
-    timed = {c: t for c, t in sweep.items() if isinstance(t, float)}
-    results["flash_autotune"] = {
-        "shape": f"B{B} H{H} S{S} D{D} causal fwd",
-        "sweep_ms": sweep,
-        "best": min(timed, key=timed.get) if timed else None,
-    }
+        timed = {c: t for c, t in sweep.items() if isinstance(t, float)}
+        results["flash_autotune"] = {
+            "shape": f"B{B} H{H} S{S} D{D} causal fwd",
+            "sweep_ms": dict(sweep),
+            "best": min(timed, key=timed.get) if timed else None,
+        }
+        flush("flash_autotune", {"flash_autotune": results["flash_autotune"]},
+              merge=True)
 
 
 def bench_xentropy(results, on_tpu):
@@ -343,12 +352,16 @@ def bench_multi_tensor(results, on_tpu):
         jax.jit(xla_lamb1), flat, flat2, m, v)
 
 
-def run(budget_left=lambda: 1e9):
+def run(budget_left=lambda: 1e9, legs_dir=None):
+    from apex_tpu.utils.bench_legs import make_flusher
+    flush = make_flusher(legs_dir)
+
     on_tpu = jax.default_backend() == "tpu"
     _log(f"backend={jax.default_backend()} (pallas "
          f"{'compiled' if on_tpu else 'interpret mode — timings not '
             'meaningful'})")
     results = {}
+    done_keys: set = set()
     for fn in (bench_attention, bench_xentropy, bench_layer_norm,
                bench_mlp, bench_multi_tensor, bench_flash_autotune,
                bench_attn_seq_sweep):
@@ -356,16 +369,30 @@ def run(budget_left=lambda: 1e9):
             _log(f"budget exhausted before {fn.__name__}")
             break
         try:
-            fn(results, on_tpu)
+            if fn in (bench_flash_autotune, bench_attn_seq_sweep):
+                fn(results, on_tpu, flush)   # long sweeps flush per-config
+            else:
+                fn(results, on_tpu)
         except Exception as err:       # a failed section must not kill the rest
             results[fn.__name__] = {"error": repr(err)[:200]}
+        # per-section leg: the keys this section added, flushed the moment
+        # the section completes (round-4 verdict item 2); merge=True so a
+        # section re-run never erases a previous window's rows
+        delta = {k: v for k, v in results.items() if k not in done_keys}
+        done_keys.update(results.keys())
+        if delta:
+            flush(fn.__name__.removeprefix("bench_"), delta, merge=True)
     return {"metric": "pallas_kernel_microbench", "backend":
             jax.default_backend(), "compiled": on_tpu, "kernels": results}
 
 
-def _inner_main():
+from apex_tpu.utils.bench_legs import argval as _argval
+
+
+def _inner_main(legs_dir=None):
     deadline = time.monotonic() + 700.0
-    print(json.dumps(run(lambda: deadline - time.monotonic())))
+    print(json.dumps(run(lambda: deadline - time.monotonic(),
+                         legs_dir=legs_dir)))
 
 
 def main():
@@ -375,12 +402,16 @@ def main():
     import subprocess
 
     from apex_tpu.utils.platform import probe_ambient_backend
+    legs_dir = _argval(sys.argv, "--legs-dir")
     healthy = probe_ambient_backend(75)
     err = ""
     if healthy:
+        cmd = [sys.executable, __file__, "--inner"]
+        if legs_dir:
+            cmd += ["--legs-dir", legs_dir]
         try:
-            r = subprocess.run([sys.executable, __file__, "--inner"],
-                               capture_output=True, text=True, timeout=780)
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=780)
             sys.stderr.write(r.stderr or "")
             for line in (r.stdout or "").splitlines():
                 if line.startswith("{"):
@@ -396,11 +427,16 @@ def main():
     deadline = time.monotonic() + 240.0
     payload = run(lambda: deadline - time.monotonic())
     payload["ambient_error"] = err
+    if legs_dir:
+        from apex_tpu.utils.bench_legs import read_tpu_legs
+        tpu_legs = read_tpu_legs(legs_dir)
+        if tpu_legs:
+            payload["tpu_partial_legs"] = tpu_legs
     print(json.dumps(payload))
 
 
 if __name__ == "__main__":
     if "--inner" in sys.argv:
-        _inner_main()
+        _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:
         main()

@@ -1,0 +1,296 @@
+"""Incremental bench-leg persistence (round-4 verdict item 2): a tunnel
+that re-wedges mid-bench must not lose completed measurements.
+
+Covers the three layers of the recovery pipeline:
+  1. ``apex_tpu.utils.bench_legs`` — flush/read/assemble primitives;
+  2. ``bench.run_bench(legs_dir=...)`` flushes the headline leg after
+     EVERY sub-measurement (simulated mid-run wedge keeps earlier ones);
+  3. ``assemble`` rebuilds a driver-shaped (partial) payload from
+     whatever legs landed, and never reports vs_baseline off-TPU.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from apex_tpu.utils.bench_legs import (assemble, flush_leg, make_flusher,
+                                       read_legs)
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_flush_and_read_roundtrip(tmp_path):
+    d = str(tmp_path / "legs")
+    flush_leg(d, "headline", {"xla_impl_ms": 1.5}, backend="tpu")
+    flush_leg(d, "rn50", {"images_per_sec": 10.0}, backend="tpu")
+    # re-flush overwrites (accreting legs)
+    flush_leg(d, "headline", {"xla_impl_ms": 1.5, "winner": "xla"},
+              backend="tpu")
+    legs = read_legs(d)
+    assert set(legs) == {"headline", "rn50"}
+    assert legs["headline"]["data"]["winner"] == "xla"
+    assert legs["headline"]["backend"] == "tpu"
+    assert legs["headline"]["ts"].endswith("Z")
+    # no tmp debris from the atomic writes
+    assert not [f for f in os.listdir(d) if f.startswith(".")]
+
+
+def test_flush_none_dir_is_noop(tmp_path):
+    flush_leg(None, "headline", {"x": 1}, backend="cpu")
+    flush_leg("", "headline", {"x": 1}, backend="cpu")
+
+
+def test_read_legs_skips_corrupt_file(tmp_path):
+    d = str(tmp_path)
+    flush_leg(d, "good", {"v": 1}, backend="tpu")
+    with open(os.path.join(d, "bad.json"), "w") as f:
+        f.write("{truncated")
+    legs = read_legs(d)
+    assert set(legs) == {"good"}
+
+
+def test_assemble_bench_partial_headline_only(tmp_path):
+    """A window that wedged after the xla timing still yields a usable
+    payload: value from the one finished impl, partial=true, and
+    vs_baseline stays null (no baseline was timed)."""
+    d = str(tmp_path)
+    flush_leg(d, "headline", {"n_params": 100, "complete": False,
+                              "xla_impl_ms": 28.8}, backend="tpu")
+    out = assemble(d, "bench")
+    assert out["partial"] is True
+    assert out["value"] == 28.8
+    assert out["vs_baseline"] is None
+    assert out["backend"] == "tpu"
+    assert out["leg_timestamps"]["headline"]
+    assert out["detail"]["xla_impl_ms"] == 28.8
+
+
+def test_assemble_bench_full_legs(tmp_path):
+    d = str(tmp_path)
+    flush_leg(d, "headline", {"n_params": 100, "complete": True,
+                              "xla_impl_ms": 28.8,
+                              "fused_flat_impl_ms": 19.0,
+                              "optax_baseline_ms": 29.4,
+                              "winner": "fused_flat"}, backend="tpu")
+    flush_leg(d, "rn50", {"images_per_sec": 800.0, "batch": 128},
+              backend="tpu")
+    flush_leg(d, "bert_e2e", {"step_ms": 900.0}, backend="tpu")
+    out = assemble(d, "bench")
+    assert out["value"] == 19.0
+    assert out["vs_baseline"] == pytest.approx(29.4 / 19.0, abs=1e-3)
+    assert out["detail"]["rn50"]["images_per_sec"] == 800.0
+    assert out["detail"]["bert_e2e"]["step_ms"] == 900.0
+    assert out["partial"] is True        # assembled => documents a kill
+
+
+def test_assemble_bench_cpu_backend_never_reports_vs_baseline(tmp_path):
+    """round-4 verdict weak #3: a CPU ratio must not surface as
+    vs_baseline even through the assembler path."""
+    d = str(tmp_path)
+    flush_leg(d, "headline", {"xla_impl_ms": 16.7,
+                              "optax_baseline_ms": 21.0}, backend="cpu")
+    out = assemble(d, "bench")
+    assert out["value"] == 16.7
+    assert out["vs_baseline"] is None
+
+
+def test_assemble_kernels_merges_sections(tmp_path):
+    d = str(tmp_path)
+    flush_leg(d, "attention", {"flash_attn_fwd": {"pallas_ms": 1.0,
+                                                  "xla_ms": 2.0}},
+              backend="tpu")
+    # intra-leg flush mid-sweep, then the section flush overwrote it with
+    # one more row — the assembler sees only the latest
+    flush_leg(d, "attn_seq_sweep",
+              {"attn_seq_sweep": {"by_seq": {"64": {"speedup": 0.9}}}},
+              backend="tpu")
+    flush_leg(d, "attn_seq_sweep",
+              {"attn_seq_sweep": {"by_seq": {"64": {"speedup": 0.9},
+                                             "128": {"speedup": 1.1}}}},
+              backend="tpu")
+    out = assemble(d, "kernels")
+    assert out["metric"] == "pallas_kernel_microbench"
+    assert out["compiled"] is True
+    assert out["kernels"]["flash_attn_fwd"]["xla_ms"] == 2.0
+    assert set(out["kernels"]["attn_seq_sweep"]["by_seq"]) == {"64", "128"}
+    assert out["partial"] is True
+
+
+def test_assemble_empty_dir(tmp_path):
+    out = assemble(str(tmp_path), "bench")
+    assert out["value"] is None and out["detail"] == {}
+    out_k = assemble(str(tmp_path / "missing"), "kernels")
+    assert out_k["kernels"] == {}
+
+
+def test_assemble_cli_prints_json(tmp_path):
+    import subprocess
+    import sys
+    d = str(tmp_path)
+    flush_leg(d, "headline", {"xla_impl_ms": 3.0}, backend="tpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.utils.bench_legs", d,
+         "--kind", "bench"],
+        capture_output=True, text=True, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["value"] == 3.0 and payload["partial"] is True
+
+
+def test_merge_flush_keeps_prior_window_measurements(tmp_path):
+    """A second recovery window that wedges EARLIER than the first must
+    not destroy the first window's captured timings (code-review r5)."""
+    d = str(tmp_path)
+    # window 1 got as far as the fused timing
+    flush_leg(d, "headline", {"xla_impl_ms": 28.8,
+                              "fused_flat_impl_ms": 19.0,
+                              "complete": False}, backend="tpu")
+    # window 2 re-measured xla (fresher value wins) then died
+    flush_leg(d, "headline", {"xla_impl_ms": 27.9, "complete": False},
+              backend="tpu", merge=True)
+    head = read_legs(d)["headline"]["data"]
+    assert head["xla_impl_ms"] == 27.9          # fresh value wins
+    assert head["fused_flat_impl_ms"] == 19.0   # old survives
+    out = assemble(d, "bench")
+    assert out["value"] == 19.0
+
+
+def test_merge_flush_deep_merges_sweep_rows(tmp_path):
+    """Kernel sweep legs: a re-run that wedged earlier keeps the rows a
+    previous window captured (code-review r5, second pass)."""
+    d = str(tmp_path)
+    flush_leg(d, "attn_seq_sweep",
+              {"attn_seq_sweep": {"by_seq": {"64": 1.0, "128": 2.0,
+                                             "256": 3.0}}},
+              backend="tpu")
+    flush_leg(d, "attn_seq_sweep",
+              {"attn_seq_sweep": {"by_seq": {"64": 0.9}}},
+              backend="tpu", merge=True)
+    rows = read_legs(d)["attn_seq_sweep"]["data"]["attn_seq_sweep"]["by_seq"]
+    assert rows == {"64": 0.9, "128": 2.0, "256": 3.0}
+
+
+def test_merge_flush_never_mixes_backends(tmp_path):
+    """A CPU re-run must not inherit (or pollute) TPU-backend legs."""
+    d = str(tmp_path)
+    flush_leg(d, "headline", {"xla_impl_ms": 28.8}, backend="tpu")
+    flush_leg(d, "headline", {"fused_flat_impl_ms": 52.0}, backend="cpu",
+              merge=True)
+    head = read_legs(d)["headline"]
+    assert head["backend"] == "cpu"
+    assert "xla_impl_ms" not in head["data"]    # no cross-backend merge
+
+
+def test_assemble_mixed_backends_tags_every_leg(tmp_path):
+    """CPU and TPU legs in one dir (half-recovered tunnel): every merged
+    value must carry its backend and no headline metric may surface from
+    the CPU leg."""
+    d = str(tmp_path)
+    flush_leg(d, "headline", {"xla_impl_ms": 16.7,
+                              "optax_baseline_ms": 21.0}, backend="cpu")
+    flush_leg(d, "rn50", {"images_per_sec": 800.0}, backend="tpu")
+    out = assemble(d, "bench")
+    assert out["backend"] == "mixed"
+    assert out["value"] is None                 # cpu headline: not the metric
+    assert out["vs_baseline"] is None
+    assert out["detail"]["_backend"] == "cpu"   # tagged headline fields
+    assert out["detail"]["rn50"]["_backend"] == "tpu"
+
+    out_k_dir = str(tmp_path / "k")
+    flush_leg(out_k_dir, "attention",
+              {"flash_attn_fwd": {"pallas_ms": 1.0}}, backend="tpu")
+    flush_leg(out_k_dir, "xentropy",
+              {"xentropy_fwd": {"pallas_ms": 9.0}}, backend="cpu")
+    out_k = assemble(out_k_dir, "kernels")
+    assert out_k["backend"] == "mixed"
+    assert out_k["kernels"]["flash_attn_fwd"]["_backend"] == "tpu"
+    assert out_k["kernels"]["xentropy_fwd"]["_backend"] == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# run_bench integration: the flush sequence under a simulated mid-run wedge
+# ---------------------------------------------------------------------------
+
+class _Wedge(Exception):
+    """Stands in for the tunnel dying mid-bench (in reality: SIGKILL)."""
+
+
+def _stub_timings(bench, monkeypatch, wedge_at=None):
+    """Replace the slow timing fns with constants; ``wedge_at`` names the
+    one that simulates the tunnel dying mid-measurement."""
+    vals = {"time_apex_xla": 28.8, "time_apex_fused_flat": 19.0,
+            "time_optax": 29.4}
+
+    def mk(name, v):
+        def f(*a, **k):
+            if name == wedge_at:
+                raise _Wedge(name)
+            return v
+        return f
+
+    for name, v in vals.items():
+        monkeypatch.setattr(bench, name, mk(name, v))
+    monkeypatch.setattr(bench, "bench_rn50",
+                        mk("bench_rn50", {"images_per_sec": 1.0}))
+    monkeypatch.setattr(bench, "bench_bert_e2e",
+                        mk("bench_bert_e2e", {"step_ms": 2.0}))
+
+
+def test_run_bench_flushes_headline_incrementally(tmp_path, monkeypatch):
+    """Wedge during the fused timing: the already-measured xla number is
+    on disk, complete=false, and no later leg files exist."""
+    bench = _load_bench()
+    _stub_timings(bench, monkeypatch, wedge_at="time_apex_fused_flat")
+    d = str(tmp_path / "legs")
+    with pytest.raises(_Wedge):
+        bench.run_bench(legs_dir=d)
+    legs = read_legs(d)
+    assert set(legs) == {"headline"}
+    head = legs["headline"]["data"]
+    assert head["xla_impl_ms"] == 28.8
+    assert head["complete"] is False
+    assert "fused_flat_impl_ms" not in head
+    # and the assembler turns the wreckage into a driver-shaped payload
+    out = assemble(d, "bench")
+    assert out["value"] == 28.8 and out["partial"] is True
+
+
+def test_run_bench_full_flush_sequence(tmp_path, monkeypatch):
+    """No wedge: headline (complete=true) + rn50 + bert legs all land,
+    and the returned payload matches the legs.  Off-TPU, vs_baseline is
+    null at top level with the ratio kept as an explicit cpu proxy."""
+    import jax
+    bench = _load_bench()
+    _stub_timings(bench, monkeypatch)
+    d = str(tmp_path / "legs")
+    payload = bench.run_bench(legs_dir=d)
+    legs = read_legs(d)
+    rn50_key = ("rn50" if jax.default_backend() == "tpu"
+                else "rn50_cpu_standin_resnet18")
+    assert set(legs) == {"headline", rn50_key, "bert_e2e"}
+    assert legs["headline"]["data"]["complete"] is True
+    assert legs["headline"]["data"]["winner"] == "fused_flat"
+    assert payload["value"] == 19.0
+    assert payload["vs_baseline"] is None          # CPU in tests
+    assert payload["detail"]["vs_baseline_cpu_proxy"] == pytest.approx(
+        29.4 / 19.0, abs=1e-3)
+    assert payload["detail"][rn50_key] == {"images_per_sec": 1.0}
+
+
+def test_run_bench_without_legs_dir_still_returns_payload(monkeypatch):
+    bench = _load_bench()
+    _stub_timings(bench, monkeypatch)
+    payload = bench.run_bench()     # legs_dir=None: flushing is a no-op
+    assert payload["metric"] == "fused_lamb_step_ms_bert_large"
+    assert payload["value"] == 19.0

@@ -162,3 +162,39 @@ def test_native_host_pack_round_trip():
         host_pack.pack(arrays, [0, 128, 400], total)
     with pytest.raises(ValueError):
         host_pack.unpack(flat, outs, [0, 128, 400])
+
+
+def test_hyperparam_mutation_invalidates_jit_cache():
+    """step math is jitted (round 5); a torch-style in-place mutation of
+    a hyperparameter between steps must retrace, not be baked in from
+    the first trace (code-review r5)."""
+    import torch
+    from apex_tpu.interop import TorchFusedOptimizer
+    from apex_tpu.optimizers import FusedSGD
+
+    p = torch.nn.Parameter(torch.zeros(8, 4))
+    opt = TorchFusedOptimizer([p], FusedSGD(lr=0.5, impl="fused"))
+    opt.step(grads=[torch.ones(8, 4)])
+    np.testing.assert_allclose(p.detach().numpy(), np.full((8, 4), -0.5),
+                               rtol=1e-6)
+    opt.optimizer.lr = 0.25                    # honored by the eager path
+    opt.step(grads=[torch.ones(8, 4)])
+    np.testing.assert_allclose(p.detach().numpy(), np.full((8, 4), -0.75),
+                               rtol=1e-6)
+
+
+def test_pack_out_reuse_and_validation():
+    from apex_tpu.utils import host_pack
+    arrays = [np.full((4,), 7.0, np.float32)]
+    out = np.zeros((128,), np.float32)
+    flat = host_pack.pack(arrays, [0], 128, out=out)
+    assert flat is out and (out[:4] == 7.0).all() and (out[4:] == 0).all()
+    # reuse: spans overwritten, gaps untouched (still zero)
+    arrays2 = [np.full((4,), 3.0, np.float32)]
+    host_pack.pack(arrays2, [0], 128, out=out)
+    assert (out[:4] == 3.0).all() and (out[4:] == 0).all()
+    with pytest.raises(ValueError):
+        host_pack.pack(arrays, [0], 64, out=out)          # wrong shape
+    with pytest.raises(ValueError):
+        host_pack.pack(arrays, [0], 128, dtype=np.float64,
+                       out=out)                           # wrong dtype

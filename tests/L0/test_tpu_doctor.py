@@ -82,4 +82,19 @@ def test_fingerprint_banner():
 def test_leaked_clients_parses_ss_output():
     doc = _load_doctor()
     # no real relay connection from the test runner
-    assert isinstance(doc.leaked_clients(), list)
+    hits, note = doc.leaked_clients()
+    assert isinstance(hits, list)
+    assert isinstance(note, str)
+
+
+def test_leaked_clients_survives_missing_ss(monkeypatch):
+    """ADVICE r4: a host without iproute2 must not crash the doctor before
+    the fingerprint/probe/watcher steps run."""
+    doc = _load_doctor()
+
+    def no_ss(*a, **k):
+        raise FileNotFoundError("ss")
+
+    monkeypatch.setattr(doc.subprocess, "run", no_ss)
+    hits, note = doc.leaked_clients()
+    assert hits == [] and "scan unavailable" in note

@@ -28,13 +28,20 @@ RELAY = ("127.0.0.1", 2024)
 
 
 def leaked_clients():
-    """PIDs with an established connection to the relay (via /proc)."""
-    out = subprocess.run(["ss", "-tnp"], capture_output=True, text=True)
+    """PIDs with an established connection to the relay (via /proc).
+
+    Returns ``(hits, note)``: ``note`` is non-empty when the scan could
+    not run (no iproute2 ``ss`` on this host) — the doctor's later steps
+    (fingerprint / probe / watcher) must still execute in that case."""
+    try:
+        out = subprocess.run(["ss", "-tnp"], capture_output=True, text=True)
+    except (FileNotFoundError, OSError) as e:
+        return [], f"scan unavailable ({e.__class__.__name__}: {e})"
     hits = []
     for line in (out.stdout or "").splitlines():
         if f"{RELAY[0]}:{RELAY[1]}" in line and "ESTAB" in line:
             hits.append(line.strip())
-    return hits
+    return hits, ""
 
 
 def relay_fingerprint():
@@ -64,8 +71,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     print("== 1. leaked local clients holding the relay ==")
-    leaks = leaked_clients()
-    if leaks:
+    leaks, scan_note = leaked_clients()
+    if scan_note:
+        print(f"  {scan_note} — continuing with the remaining checks")
+    elif leaks:
         for l in leaks:
             print("  LEAK:", l)
         print("  -> kill the owning pid(s), then re-run; this is the only "
