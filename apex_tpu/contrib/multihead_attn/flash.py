@@ -60,10 +60,19 @@ def _clamp_blocks(bq, bk, D, esz, bias_per_q, bwd=False, sq=None, sk=None):
     # would run a different kernel than the one measured)
     bq_pinned = bq is not None or "APEX_TPU_FLASH_BLOCK_Q" in os.environ
     bk_pinned = bk is not None or "APEX_TPU_FLASH_BLOCK_K" in os.environ
+    # precedence: argument > env pin > measured tuning profile > built-in.
+    # Tuned values are NOT pins: the autotune sweeps one shape, and the
+    # VMEM clamp below must still protect other shapes from a config
+    # that only fit where it was measured.
+    from ...utils import tuning
     if bq is None:
-        bq = int(os.environ.get("APEX_TPU_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
+        bq = int(os.environ.get("APEX_TPU_FLASH_BLOCK_Q",
+                                tuning.get_on_tpu("flash_block_q",
+                                                  DEFAULT_BLOCK_Q)))
     if bk is None:
-        bk = int(os.environ.get("APEX_TPU_FLASH_BLOCK_K", DEFAULT_BLOCK_K))
+        bk = int(os.environ.get("APEX_TPU_FLASH_BLOCK_K",
+                                tuning.get_on_tpu("flash_block_k",
+                                                  DEFAULT_BLOCK_K)))
     if sq is not None:
         bq = min(bq, max(8, -(-sq // 8) * 8))
     if sk is not None:

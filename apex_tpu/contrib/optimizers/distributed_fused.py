@@ -80,7 +80,14 @@ class _DistributedFusedBase:
 
     def __init__(self, lr, weight_decay=0.0, shard_axis="data",
                  replica_axis: Optional[str] = None, predivide=True,
-                 bf16_allgather=False, check_overflow=True, impl="xla"):
+                 bf16_allgather=False, check_overflow=True, impl=None):
+        if impl is None:
+            # measured tuning profile ("zero_impl", written by
+            # tools/apply_perf_results.py from the on-chip adam_update /
+            # lamb_stage1 A/B), falling back to the PERF_NOTES §2
+            # measured default: the XLA fusion over flat buffers
+            from ...utils import tuning
+            impl = tuning.get_on_tpu("zero_impl", "xla")
         if impl not in ("xla", "fused"):
             raise ValueError(f"impl must be 'xla' or 'fused', got {impl!r}")
         self.lr = lr

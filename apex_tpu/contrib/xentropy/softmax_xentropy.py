@@ -143,10 +143,14 @@ def softmax_xentropy_loss(logits, labels, smoothing=0.0, padding_idx=0,
 def _fwd(logits, labels, smoothing, impl):
     if impl == "auto":
         # APEX_TPU_XENT_IMPL overrides the auto choice — the bench
-        # harness's safety hatch for first-contact Mosaic failures
+        # harness's safety hatch for first-contact Mosaic failures;
+        # next, the measured tuning profile (tools/apply_perf_results.py
+        # records the on-chip pallas-vs-xla winner); else pallas on TPU
         import os
-        impl = os.environ.get("APEX_TPU_XENT_IMPL", "") or (
-            "pallas" if jax.default_backend() == "tpu" else "xla")
+        from ...utils import tuning
+        impl = (os.environ.get("APEX_TPU_XENT_IMPL", "")
+                or tuning.get_on_tpu("xent_auto_impl")
+                or ("pallas" if jax.default_backend() == "tpu" else "xla"))
     if impl == "pallas":
         return _xent_fwd_pallas(logits, labels, smoothing)
     return _xent_fwd_xla(logits, labels, smoothing)

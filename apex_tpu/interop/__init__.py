@@ -121,12 +121,14 @@ class TorchFusedOptimizer:
                 gs.append(p.grad)
         else:
             gs = list(grads)
-        # route a plain-float optimizer lr through the traced lr argument:
+        # route a scalar optimizer lr through the traced lr argument:
         # the torch scheduler idiom (opt.optimizer.lr = sched(step) before
         # every step) then updates a traced scalar instead of recompiling
         # per value (hyperparameter changes OTHER than lr still retrace —
-        # see _jitted)
-        if lr is None and isinstance(self.optimizer.lr, (int, float)):
+        # see _jitted).  numbers.Real covers numpy scalars too
+        # (np.float32 is not a float subclass).
+        import numbers
+        if lr is None and isinstance(self.optimizer.lr, numbers.Real):
             lr = float(self.optimizer.lr)
         if self._native_fast_path_ok(gs):
             return self._step_packed(gs, scale, lr)
@@ -155,7 +157,7 @@ class TorchFusedOptimizer:
             self._state = self._state._replace(
                 master=self.optimizer.flattener.flatten(ptree))
         self._jax_params = ptree
-        if lr is None or isinstance(lr, (int, float)):
+        if lr is None or isinstance(lr, numbers.Real):
             fn = self._jitted("tree", lr is not None)
             args = (self._state, gtree, self._jax_params,
                     jnp.float32(scale))
@@ -186,10 +188,11 @@ class TorchFusedOptimizer:
         NOT recompile per value.  The cache is bounded: per-step
         mutation of a keyed hyperparameter degrades to retrace-per-step
         (correct, slow) without also growing memory per step."""
+        import numbers
         hypers = tuple(sorted(
-            (k, v) for k, v in vars(self.optimizer).items()
-            if isinstance(v, (int, float, bool, str, tuple))
-            and k != "lr"))
+            (k, float(v) if isinstance(v, numbers.Real) else v)
+            for k, v in vars(self.optimizer).items()
+            if isinstance(v, (numbers.Real, str, tuple)) and k != "lr"))
         key = (kind, has_lr, hypers)
         if key not in self._jit_cache and len(self._jit_cache) >= 16:
             self._jit_cache.pop(next(iter(self._jit_cache)))
@@ -272,7 +275,8 @@ class TorchFusedOptimizer:
         np.copyto(self._xfer_p, self._stage_p)
         flat_g = jnp.asarray(self._xfer_g)
         flat_p = jnp.asarray(self._xfer_p)
-        if lr is None or isinstance(lr, (int, float)):
+        import numbers
+        if lr is None or isinstance(lr, numbers.Real):
             fn = self._jitted("flat", lr is not None)
             args = (self._state._replace(master=None), flat_p, flat_g,
                     jnp.float32(scale))

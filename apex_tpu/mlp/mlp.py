@@ -52,7 +52,7 @@ class MLP:
     """
 
     def __init__(self, mlp_sizes: Sequence[int], bias=True, relu=True,
-                 activation=None, use_pallas=False):
+                 activation=None, use_pallas=None):
         if activation is None:
             activation = "relu" if relu else "none"
         if activation not in ("none", "relu", "sigmoid"):
@@ -61,7 +61,11 @@ class MLP:
         self.bias = bias
         self.activation = activation
         # Pallas fused GEMM+epilogue per layer (ops/fused_mlp.py) — the
-        # mlp_cuda perf-ceiling analog (SURVEY §2.2)
+        # mlp_cuda perf-ceiling analog (SURVEY §2.2).  None = measured
+        # tuning profile ("mlp_use_pallas"), falling back to XLA.
+        if use_pallas is None:
+            from ..utils import tuning
+            use_pallas = bool(tuning.get_on_tpu("mlp_use_pallas", False))
         self.use_pallas = use_pallas
 
     def init(self, rng):

@@ -66,8 +66,15 @@ def flush_leg(legs_dir: Optional[str], name: str, data: Any,
     if backend is None:
         import jax
         backend = jax.default_backend()
+    old = read_legs(legs_dir).get(name)
+    if (old is not None and old.get("backend") == "tpu"
+            and backend != "tpu"):
+        # never downgrade: a CPU re-run into the same legs dir (jax
+        # fell back after the probe succeeded) must not destroy a
+        # previously captured TPU measurement — the TPU leg IS the
+        # perf story; the CPU record is noise here
+        return
     if merge and isinstance(data, dict):
-        old = read_legs(legs_dir).get(name)
         if (old is not None and old.get("backend") == backend
                 and isinstance(old.get("data"), dict)):
             data = _deep_merge(old["data"], data)
@@ -138,7 +145,11 @@ def assemble(legs_dir: str, kind: str = "bench") -> dict:
     legs = read_legs(legs_dir)
     ts = {name: rec.get("ts") for name, rec in legs.items()}
     backends = {rec.get("backend") for rec in legs.values()}
-    backend = backends.pop() if len(backends) == 1 else "mixed"
+    # "none" (not "mixed") for an empty dir: nothing was measured on ANY
+    # backend, and downstream tooling treats "mixed" as partially
+    # TPU-backed (apply_perf_results' tpu_sourced gate)
+    backend = (backends.pop() if len(backends) == 1
+               else "mixed" if backends else "none")
 
     def tag(rec, data):
         """With mixed backends, every merged value must say which

@@ -1,0 +1,92 @@
+"""Measured-tuning profile: ``tuned_defaults.json``.
+
+The round-5 close of the perf loop: on-chip benchmark results
+(`bench.py` / `bench_kernels.py`) are distilled by
+``tools/apply_perf_results.py`` into one JSON profile of measured
+winners, and every tunable default consults it at trace time:
+
+  - flash-attention block sizes (``flash_block_q`` / ``flash_block_k``)
+  - the xentropy ``impl="auto"`` resolution (``xent_auto_impl``)
+  - the flagship BERT config's attention path (``bert_attn_impl``)
+  - layer-norm / MLP Pallas-vs-XLA choice (``layer_norm_use_pallas``,
+    ``mlp_use_pallas``) via their ``use_pallas=None`` auto mode
+  - the ZeRO optimizers' kernel impl (``zero_impl``) via ``impl=None``
+
+Precedence everywhere: explicit argument > env override > tuning
+profile > built-in default.  With no profile on disk nothing changes —
+the built-ins are the PERF_NOTES §2 measured-on-CPU-era choices.
+
+The reference hard-codes its equivalents per-architecture inside CUDA
+launch configs (e.g. the block constants in
+``apex/contrib/csrc/multihead_attn/*_kernel.cu``); a data-driven profile
+is the TPU-first analog because XLA/Mosaic performance shifts with
+compiler versions — re-run the bench, regenerate the profile, no code
+edit.
+
+Profile location: ``$APEX_TPU_TUNING_FILE`` if set, else
+``apex_tpu/tuned_defaults.json`` next to this package.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+_cache: Optional[dict] = None
+_cache_src: Optional[str] = None
+
+
+def profile_path() -> str:
+    env = os.environ.get("APEX_TPU_TUNING_FILE")
+    if env:
+        return env
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tuned_defaults.json")
+
+
+def _load() -> dict:
+    global _cache, _cache_src
+    path = profile_path()
+    if _cache is not None and _cache_src == path:
+        return _cache
+    data: dict = {}
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+        if isinstance(loaded, dict):
+            data = loaded
+    except (OSError, ValueError):
+        pass
+    _cache, _cache_src = data, path
+    return data
+
+
+def reload() -> None:
+    """Drop the cached profile (tests; or after regenerating the file).
+    Note jit-compiled functions that already traced with old values keep
+    them — tuning is read at trace time, like every other static knob."""
+    global _cache, _cache_src
+    _cache = None
+    _cache_src = None
+
+
+def get(key: str, default: Any = None) -> Any:
+    """Measured value for ``key``, else ``default``."""
+    return _load().get(key, default)
+
+
+def get_on_tpu(key: str, default: Any = None) -> Any:
+    """Measured value for ``key`` — applied ONLY on the TPU backend.
+
+    The profile records on-chip winners; applying them to CPU runs
+    would route interpret-mode Pallas (orders of magnitude slower) or
+    flip state layouts the measurements say nothing about.  This is the
+    accessor every runtime default should use; plain :func:`get` is for
+    backend-independent values and tooling."""
+    import jax
+    try:
+        if jax.default_backend() != "tpu":
+            return default
+    except Exception:  # backend not initializable: stay on built-ins
+        return default
+    return _load().get(key, default)

@@ -127,10 +127,14 @@ def test_assemble_kernels_merges_sections(tmp_path):
 
 
 def test_assemble_empty_dir(tmp_path):
+    """No legs => backend 'none' (not 'mixed'): nothing was measured on
+    ANY backend, and downstream tooling treats 'mixed' as partially
+    TPU-backed."""
     out = assemble(str(tmp_path), "bench")
     assert out["value"] is None and out["detail"] == {}
+    assert out["backend"] == "none"
     out_k = assemble(str(tmp_path / "missing"), "kernels")
-    assert out_k["kernels"] == {}
+    assert out_k["kernels"] == {} and out_k["backend"] == "none"
 
 
 def test_assemble_cli_prints_json(tmp_path):
@@ -182,14 +186,22 @@ def test_merge_flush_deep_merges_sweep_rows(tmp_path):
 
 
 def test_merge_flush_never_mixes_backends(tmp_path):
-    """A CPU re-run must not inherit (or pollute) TPU-backend legs."""
+    """A CPU re-run must neither inherit NOR destroy TPU-backend legs:
+    the TPU measurement is the perf story, the CPU record is noise."""
     d = str(tmp_path)
     flush_leg(d, "headline", {"xla_impl_ms": 28.8}, backend="tpu")
     flush_leg(d, "headline", {"fused_flat_impl_ms": 52.0}, backend="cpu",
               merge=True)
     head = read_legs(d)["headline"]
-    assert head["backend"] == "cpu"
-    assert "xla_impl_ms" not in head["data"]    # no cross-backend merge
+    assert head["backend"] == "tpu"             # tpu leg preserved
+    assert head["data"] == {"xla_impl_ms": 28.8}
+    # and the same protection without merge (plain overwrite attempt)
+    flush_leg(d, "headline", {"fused_flat_impl_ms": 52.0}, backend="cpu")
+    assert read_legs(d)["headline"]["backend"] == "tpu"
+    # a TPU re-run may of course overwrite a CPU leg (upgrade)
+    flush_leg(d, "rn50", {"ips": 1.0}, backend="cpu")
+    flush_leg(d, "rn50", {"ips": 900.0}, backend="tpu")
+    assert read_legs(d)["rn50"]["data"]["ips"] == 900.0
 
 
 def test_assemble_mixed_backends_tags_every_leg(tmp_path):

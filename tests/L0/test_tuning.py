@@ -1,0 +1,233 @@
+"""Measured-tuning profile (apex_tpu/utils/tuning.py) and the decision
+engine that writes it (tools/apply_perf_results.py).
+
+The round-5 close of the perf loop: on-chip bench JSONs -> profile of
+measured winners -> every tunable default consults it.  These tests
+drive the chain with synthetic TPU artifacts (the real ones are written
+by the tunnel watcher on recovery).
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from apex_tpu.utils import tuning
+
+
+@pytest.fixture
+def profile(tmp_path, monkeypatch):
+    """Point the tuning profile at a temp file; restore after."""
+    path = tmp_path / "tuned.json"
+
+    def write(d):
+        path.write_text(json.dumps(d))
+        tuning.reload()
+
+    monkeypatch.setenv("APEX_TPU_TUNING_FILE", str(path))
+    tuning.reload()
+    yield write
+    monkeypatch.delenv("APEX_TPU_TUNING_FILE")
+    tuning.reload()
+
+
+def test_get_without_profile_returns_default(profile):
+    assert tuning.get("flash_block_q") is None
+    assert tuning.get("flash_block_q", 512) == 512
+
+
+def test_get_reads_profile_and_reload(profile):
+    profile({"flash_block_q": 256})
+    assert tuning.get("flash_block_q", 512) == 256
+    profile({"flash_block_q": 128})
+    assert tuning.get("flash_block_q", 512) == 128
+
+
+def test_corrupt_profile_is_ignored(tmp_path, monkeypatch):
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    monkeypatch.setenv("APEX_TPU_TUNING_FILE", str(p))
+    tuning.reload()
+    assert tuning.get("anything", "fallback") == "fallback"
+    monkeypatch.delenv("APEX_TPU_TUNING_FILE")
+    tuning.reload()
+
+
+@pytest.fixture
+def fake_tpu(monkeypatch):
+    """Profile values only apply on the TPU backend (get_on_tpu); fake
+    it for the consumer tests — nothing here executes a kernel."""
+    import jax
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+
+def test_profile_ignored_off_tpu(profile):
+    """On the CPU backend (the real test env) measured values must NOT
+    apply — they would route interpret-mode Pallas (code-review r5)."""
+    from apex_tpu.contrib.multihead_attn.flash import (_clamp_blocks,
+                                                      DEFAULT_BLOCK_Q)
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.models import bert_large_config
+    profile({"flash_block_q": 128, "flash_block_k": 256,
+             "zero_impl": "fused", "bert_attn_impl": "fast"})
+    bq, _bk = _clamp_blocks(None, None, D=64, esz=2, bias_per_q=False,
+                            sq=4096, sk=4096)
+    assert bq == DEFAULT_BLOCK_Q
+    assert DistributedFusedAdam(lr=1e-3).impl == "xla"
+    assert bert_large_config(num_layers=2).attn_impl == "default"
+
+
+def test_flash_clamp_consults_profile(profile, fake_tpu):
+    from apex_tpu.contrib.multihead_attn.flash import _clamp_blocks
+    profile({"flash_block_q": 128, "flash_block_k": 256})
+    bq, bk = _clamp_blocks(None, None, D=64, esz=2, bias_per_q=False)
+    assert (bq, bk) == (128, 256)
+    # explicit arguments always win over the profile
+    bq, bk = _clamp_blocks(64, 128, D=64, esz=2, bias_per_q=False)
+    assert (bq, bk) == (64, 128)
+
+
+def test_layer_norm_auto_uses_profile(profile, fake_tpu, monkeypatch):
+    import jax.numpy as jnp
+    from apex_tpu.normalization import fused_layer_norm_affine
+    from apex_tpu import ops
+    profile({"layer_norm_use_pallas": True})
+    called = {}
+    import apex_tpu.ops.layer_norm as lnmod
+
+    def spy(x, w, b, shape, eps):
+        called["pallas"] = True
+        return x
+
+    monkeypatch.setattr(lnmod, "layer_norm_pallas", spy)
+    x = jnp.ones((4, 8), jnp.float32)
+    fused_layer_norm_affine(x, jnp.ones(8), jnp.zeros(8), (8,))
+    assert called.get("pallas")
+    # explicit False wins over the profile
+    called.clear()
+    fused_layer_norm_affine(x, jnp.ones(8), jnp.zeros(8), (8,),
+                            use_pallas=False)
+    assert not called
+
+
+def test_zero_impl_auto_uses_profile(profile, fake_tpu):
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    profile({"zero_impl": "fused"})
+    assert DistributedFusedAdam(lr=1e-3).impl == "fused"
+    profile({})
+    assert DistributedFusedAdam(lr=1e-3).impl == "xla"
+    assert DistributedFusedAdam(lr=1e-3, impl="xla").impl == "xla"
+
+
+def test_bert_config_attn_from_profile(profile, fake_tpu):
+    from apex_tpu.models import bert_large_config
+    profile({"bert_attn_impl": "fast"})
+    assert bert_large_config(num_layers=2).attn_impl == "fast"
+    assert bert_large_config(num_layers=2,
+                             attn_impl="default").attn_impl == "default"
+    profile({})
+    assert bert_large_config(num_layers=2).attn_impl == "default"
+
+
+# ---------------------------------------------------------------------------
+# decision engine
+# ---------------------------------------------------------------------------
+
+def _load_apply():
+    spec = importlib.util.spec_from_file_location(
+        "apply_perf_results", os.path.join(ROOT, "tools",
+                                           "apply_perf_results.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tpu_artifacts():
+    bench = {"metric": "fused_lamb_step_ms_bert_large", "value": 19.0,
+             "vs_baseline": 1.55, "backend": "tpu",
+             "detail": {"winner": "fused_flat", "xla_impl_ms": 28.8,
+                        "fused_flat_impl_ms": 19.0,
+                        "optax_baseline_ms": 29.4}}
+    kern = {"metric": "pallas_kernel_microbench", "backend": "tpu",
+            "kernels": {
+                "flash_autotune": {"best": "256x1024",
+                                   "sweep_ms": {"256x1024": 1.2}},
+                "xentropy_fwdbwd": {"speedup": 1.3},
+                "layer_norm_fwdbwd": {"speedup": 0.8},
+                "mlp_fwdbwd": {"speedup": 1.1},
+                "adam_update": {"speedup": 1.2},
+                "lamb_stage1": {"speedup": 0.9},
+                "attn_seq_sweep": {"by_seq": {
+                    "64": {"speedup": 0.8}, "512": {"speedup": 1.4},
+                    "1024": {"speedup": 1.8}, "2048": {"speedup": 2.2}}},
+            }}
+    return bench, kern
+
+
+def test_decide_applies_rules():
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    prof, rows = mod.decide(bench, kern)
+    assert prof["flash_block_q"] == 256 and prof["flash_block_k"] == 1024
+    assert prof["xent_auto_impl"] == "pallas"
+    assert prof["layer_norm_use_pallas"] is False
+    assert prof["mlp_use_pallas"] is True
+    assert prof["zero_impl"] == "xla"          # lamb_stage1 lost
+    assert prof["bert_attn_impl"] == "fast"    # mean(1.4,1.8,2.2) >= 1
+    assert any("headline" in r[0] for r in rows)
+
+
+def test_decide_skips_cpu_tagged_kernels():
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    kern["backend"] = "mixed"
+    kern["kernels"]["xentropy_fwdbwd"]["_backend"] = "cpu"
+    prof, _ = mod.decide(bench, kern)
+    assert "xent_auto_impl" not in prof        # cpu evidence rejected
+    assert prof["flash_block_q"] == 256        # tpu evidence kept
+
+
+def test_cli_refuses_cpu_artifacts(tmp_path):
+    bench = tmp_path / "b.json"
+    bench.write_text(json.dumps({"backend": "cpu", "detail": {}}))
+    kern = tmp_path / "k.json"
+    kern.write_text(json.dumps({"backend": "cpu", "kernels": {}}))
+    out = tmp_path / "tuned.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "apply_perf_results.py"),
+         "--bench", str(bench), "--kernels", str(kern), "--out", str(out)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    assert r.returncode == 1
+    assert "refusing" in r.stderr
+    assert not out.exists()
+
+
+def test_cli_writes_profile_and_notes(tmp_path):
+    mod_bench, mod_kern = _tpu_artifacts()
+    bench = tmp_path / "b.json"
+    bench.write_text(json.dumps(mod_bench))
+    kern = tmp_path / "k.json"
+    kern.write_text(json.dumps(mod_kern))
+    out = tmp_path / "tuned.json"
+    notes = tmp_path / "notes.md"
+    notes.write_text("# notes\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "apply_perf_results.py"),
+         "--bench", str(bench), "--kernels", str(kern), "--out", str(out),
+         "--notes", str(notes)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    assert r.returncode == 0, r.stderr
+    prof = json.loads(out.read_text())
+    assert prof["flash_block_q"] == 256
+    assert prof["_provenance"]["bench"] == "b.json"
+    assert "| knob | decision |" in r.stdout
+    assert "Measured winners applied" in notes.read_text()

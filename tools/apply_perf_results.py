@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Apply on-chip benchmark results to the framework's tunable defaults.
+
+Reads the TPU bench artifacts (``BENCH_TPU_r5.json`` +
+``BENCH_KERNELS_TPU_r5.json`` by default), applies the PERF_NOTES §5
+decision rules, and writes ``apex_tpu/tuned_defaults.json`` — the
+measured-tuning profile every tunable default consults
+(``apex_tpu/utils/tuning.py``).  Prints a markdown results table
+(the PERF_NOTES §7 record) to stdout; ``--notes FILE`` appends it there.
+
+Decision rules (each key is only written when its evidence is present
+and TPU-backed; absent keys leave the built-in defaults untouched):
+
+  flash_block_q/k       <- flash_autotune.best (the swept winner)
+  xent_auto_impl        <- xentropy_fwdbwd speedup (pallas vs xla)
+  bert_attn_impl        <- attn_seq_sweep: mean fast-vs-default speedup
+                           at seq >= 512 (the flagship's regime)
+  layer_norm_use_pallas <- layer_norm_fwdbwd speedup > 1
+  mlp_use_pallas        <- mlp_fwdbwd speedup > 1
+  zero_impl             <- adam_update AND lamb_stage1 speedups > 1
+
+The headline flat-engine winner and vs_baseline are recorded in the
+table (informational — the optimizer ``impl`` is a user-facing state
+layout choice, not auto-flipped).
+
+Run automatically by tpu_watch.sh after both benches complete; safe to
+re-run by hand.  Refuses to write from non-TPU artifacts.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"[apply_perf] cannot read {path}: {e}", file=sys.stderr)
+        return None
+
+
+def _tpu_kernel(kernels, name):
+    """Kernel record, only if TPU-backed (handles the mixed-backend
+    ``_backend`` tagging of assembled partials)."""
+    rec = (kernels or {}).get(name)
+    if not isinstance(rec, dict):
+        return None
+    if rec.get("_backend") not in (None, "tpu"):
+        return None
+    return rec
+
+
+def decide(bench, kern):
+    """(profile dict, list of (knob, decision, evidence) table rows)."""
+    prof = {}
+    rows = []
+
+    kernels = (kern or {}).get("kernels") if isinstance(kern, dict) else None
+    kern_tpu = isinstance(kern, dict) and kern.get("backend") in ("tpu",
+                                                                  "mixed")
+
+    if kern_tpu:
+        at = _tpu_kernel(kernels, "flash_autotune")
+        best = at.get("best") if at else None
+        if isinstance(best, str) and "x" in best:
+            bq, bk = (int(v) for v in best.split("x"))
+            prof["flash_block_q"] = bq
+            prof["flash_block_k"] = bk
+            rows.append(("flash blocks", f"{bq}x{bk}",
+                         f"autotune sweep {at.get('sweep_ms')}"))
+
+        xe = _tpu_kernel(kernels, "xentropy_fwdbwd") or _tpu_kernel(
+            kernels, "xentropy_fwd")
+        sp = xe.get("speedup") if xe else None
+        if isinstance(sp, (int, float)):
+            prof["xent_auto_impl"] = "pallas" if sp > 1.0 else "xla"
+            rows.append(("xent_auto_impl", prof["xent_auto_impl"],
+                         f"pallas speedup {sp}x"))
+
+        sweep = _tpu_kernel(kernels, "attn_seq_sweep")
+        by_seq = (sweep or {}).get("by_seq") or {}
+        longs = [r.get("speedup") for s, r in by_seq.items()
+                 if isinstance(r, dict) and int(s) >= 512
+                 and isinstance(r.get("speedup"), (int, float))]
+        if longs:
+            mean_sp = sum(longs) / len(longs)
+            prof["bert_attn_impl"] = "fast" if mean_sp >= 1.0 else "default"
+            rows.append(("bert_attn_impl", prof["bert_attn_impl"],
+                         f"mean fast-vs-default speedup {mean_sp:.2f}x "
+                         f"at seq>=512 (n={len(longs)})"))
+
+        ln = _tpu_kernel(kernels, "layer_norm_fwdbwd")
+        sp = ln.get("speedup") if ln else None
+        if isinstance(sp, (int, float)):
+            prof["layer_norm_use_pallas"] = sp > 1.0
+            rows.append(("layer_norm_use_pallas",
+                         str(prof["layer_norm_use_pallas"]).lower(),
+                         f"pallas speedup {sp}x"))
+
+        ml = _tpu_kernel(kernels, "mlp_fwdbwd")
+        sp = ml.get("speedup") if ml else None
+        if isinstance(sp, (int, float)):
+            prof["mlp_use_pallas"] = sp > 1.0
+            rows.append(("mlp_use_pallas",
+                         str(prof["mlp_use_pallas"]).lower(),
+                         f"pallas speedup {sp}x"))
+
+        zs = []
+        for name in ("adam_update", "lamb_stage1"):
+            k = _tpu_kernel(kernels, name)
+            sp = k.get("speedup") if k else None
+            if isinstance(sp, (int, float)):
+                zs.append(sp)
+        if len(zs) == 2:
+            prof["zero_impl"] = "fused" if min(zs) > 1.0 else "xla"
+            rows.append(("zero_impl", prof["zero_impl"],
+                         f"pallas speedups adam {zs[0]}x / lamb-s1 {zs[1]}x"))
+
+    if isinstance(bench, dict) and bench.get("backend") in ("tpu", "mixed"):
+        det = bench.get("detail") or {}
+        if det.get("_backend") in (None, "tpu"):
+            winner = det.get("winner")
+            if winner:
+                rows.append(("headline winner (informational)", winner,
+                             f"xla {det.get('xla_impl_ms')} ms vs "
+                             f"fused_flat {det.get('fused_flat_impl_ms')} ms; "
+                             f"optax {det.get('optax_baseline_ms')} ms; "
+                             f"vs_baseline {bench.get('vs_baseline')}"))
+
+    return prof, rows
+
+
+def render(rows):
+    out = ["| knob | decision | evidence |", "|---|---|---|"]
+    out += [f"| {k} | {d} | {e} |" for k, d, e in rows]
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--bench", default=os.path.join(REPO, "BENCH_TPU_r5.json"))
+    ap.add_argument("--kernels",
+                    default=os.path.join(REPO, "BENCH_KERNELS_TPU_r5.json"))
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "apex_tpu", "tuned_defaults.json"))
+    ap.add_argument("--notes", help="append the results table to this file")
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args(argv)
+
+    bench = _load(args.bench)
+    kern = _load(args.kernels)
+    tpu_sourced = any(isinstance(d, dict) and d.get("backend") in
+                      ("tpu", "mixed") for d in (bench, kern))
+    if not tpu_sourced:
+        print("[apply_perf] no TPU-backed artifact found; refusing to write "
+              "a tuning profile from CPU numbers", file=sys.stderr)
+        return 1
+
+    prof, rows = decide(bench, kern)
+    table = render(rows)
+    print(table)
+    if not prof:
+        print("[apply_perf] no decidable knobs in the artifacts; nothing "
+              "written", file=sys.stderr)
+        return 1
+    if args.dry_run:
+        return 0
+
+    prof["_provenance"] = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "bench": os.path.basename(args.bench),
+        "kernels": os.path.basename(args.kernels),
+    }
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(prof, f, indent=1, sort_keys=True)
+    os.replace(tmp, args.out)
+    print(f"[apply_perf] wrote {args.out}", file=sys.stderr)
+
+    if args.notes:
+        stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+        with open(args.notes, "a") as f:
+            f.write(f"\n## 7. Measured winners applied ({stamp})\n\n"
+                    f"{table}\n\nProfile: `apex_tpu/tuned_defaults.json` "
+                    f"(every knob consults it — utils/tuning.py).\n")
+        print(f"[apply_perf] appended results table to {args.notes}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

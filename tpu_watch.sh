@@ -7,64 +7,97 @@
 # PROBING — a later, longer window overwrites partial artifacts with a
 # complete run.  A bench whose artifact is already complete (non-partial,
 # TPU-backend) is SKIPPED on later windows, so a short window goes
-# straight to whatever is still missing.  Exits when both are complete.
+# straight to whatever is still missing.  When both are complete it
+# applies the measured winners to the tuning profile
+# (tools/apply_perf_results.py -> apex_tpu/tuned_defaults.json), writes
+# TUNNEL_LIVE, and exits.
+#
+# Every command/path/timeout is env-overridable (APEX_WATCH_*) so the
+# control flow is testable with fake benches (test_tpu_watch.py) —
+# probes, skip-when-complete, partial assembly, resume.
 #
 # Single-client tunnel: while this script is running it OWNS the chip.
 # The interactive session must kill it before dialing the tunnel itself
 # (see docs/tpu_tunnel.md; pkill -f "bash tpu_watch").
-cd /root/repo
+cd "${APEX_WATCH_DIR:-/root/repo}"
+
+LOG=${APEX_WATCH_LOG:-tpu_watch.out}
+SLEEP=${APEX_WATCH_SLEEP:-300}
+N_PROBES=${APEX_WATCH_PROBES:-144}
+BENCH_JSON=${APEX_WATCH_BENCH_JSON:-BENCH_TPU_r5.json}
+KERN_JSON=${APEX_WATCH_KERN_JSON:-BENCH_KERNELS_TPU_r5.json}
+BENCH_LEGS=${APEX_WATCH_BENCH_LEGS:-BENCH_LEGS_r5}
+KERN_LEGS=${APEX_WATCH_KERN_LEGS:-BENCH_KERNELS_LEGS_r5}
+PROBE_CMD=${APEX_WATCH_PROBE_CMD:-'timeout 90 python -c "from apex_tpu.utils.platform import probe_ambient_backend as p
+r = p(75); print(r.detail); raise SystemExit(0 if r else 1)"'}
+BENCH_CMD=${APEX_WATCH_BENCH_CMD:-"python bench.py --inner --legs-dir $BENCH_LEGS"}
+KERN_CMD=${APEX_WATCH_KERN_CMD:-"python bench_kernels.py --inner --legs-dir $KERN_LEGS"}
+ASSEMBLE_CMD=${APEX_WATCH_ASSEMBLE_CMD:-"python -m apex_tpu.utils.bench_legs"}
+APPLY_CMD=${APEX_WATCH_APPLY_CMD:-"python tools/apply_perf_results.py --notes PERF_NOTES.md"}
+BENCH_TO=${APEX_WATCH_BENCH_TO:-700}
+KERN_TO=${APEX_WATCH_KERN_TO:-860}
 
 complete() {  # $1: artifact path — complete TPU-backend run?
   [ -s "$1" ] && grep -q '"backend": "tpu"' "$1" \
     && ! grep -q '"partial": true' "$1"
 }
 
-for i in $(seq 1 144); do
-  # single source for probe + failure formatting: platform.ProbeResult
-  out=$(timeout 90 python -c "from apex_tpu.utils.platform import probe_ambient_backend as p
-r = p(75); print(r.detail); raise SystemExit(0 if r else 1)" 2>&1)
+for i in $(seq 1 "$N_PROBES"); do
+  out=$(bash -c "$PROBE_CMD" 2>&1)   # ProbeResult is the single source
   rc=$?
   if [ $rc -eq 0 ]; then
-    echo "$(date +%H:%M:%S) tunnel healthy — running benches (legs incremental)" >> tpu_watch.out
-    if complete BENCH_TPU_r5.json; then
-      echo "$(date +%H:%M:%S) bench.py already complete; skipping" >> tpu_watch.out
+    echo "$(date +%H:%M:%S) tunnel healthy — running benches (legs incremental)" >> "$LOG"
+    if complete "$BENCH_JSON"; then
+      echo "$(date +%H:%M:%S) bench.py already complete; skipping" >> "$LOG"
     else
       # -k 10: a client hung in the C++ dial ignores SIGTERM; follow with KILL
-      timeout -k 10 700 python bench.py --inner --legs-dir BENCH_LEGS_r5 \
-        > BENCH_TPU_r5.json 2>> tpu_watch.out
+      timeout -k 10 "$BENCH_TO" bash -c "$BENCH_CMD" > "$BENCH_JSON" 2>> "$LOG"
       rc1=$?
-      echo "$(date +%H:%M:%S) bench.py done rc=$rc1" >> tpu_watch.out
-      if [ $rc1 -ne 0 ] || [ ! -s BENCH_TPU_r5.json ]; then
+      echo "$(date +%H:%M:%S) bench.py done rc=$rc1" >> "$LOG"
+      if [ $rc1 -ne 0 ] || [ ! -s "$BENCH_JSON" ]; then
         # mid-run wedge: completed legs still settle what they can
-        python -m apex_tpu.utils.bench_legs BENCH_LEGS_r5 --kind bench \
-          > BENCH_TPU_r5.json 2>> tpu_watch.out
-        echo "$(date +%H:%M:%S) bench.py FAILED mid-run; assembled partial from legs, resuming probe loop" >> tpu_watch.out
-        sleep 300
+        $ASSEMBLE_CMD "$BENCH_LEGS" --kind bench > "$BENCH_JSON" 2>> "$LOG"
+        echo "$(date +%H:%M:%S) bench.py FAILED mid-run; assembled partial from legs, resuming probe loop" >> "$LOG"
+        sleep "$SLEEP"
+        continue
+      fi
+      if ! complete "$BENCH_JSON"; then
+        # rc=0 but not a complete TPU run (e.g. jax fell back to CPU
+        # after a healthy probe): the mission is TPU numbers — keep
+        # probing rather than exiting with a CPU artifact
+        echo "$(date +%H:%M:%S) bench.py produced a non-TPU/partial artifact; resuming probe loop" >> "$LOG"
+        sleep "$SLEEP"
         continue
       fi
     fi
-    if complete BENCH_KERNELS_TPU_r5.json; then
-      echo "$(date +%H:%M:%S) bench_kernels.py already complete; skipping" >> tpu_watch.out
+    if complete "$KERN_JSON"; then
+      echo "$(date +%H:%M:%S) bench_kernels.py already complete; skipping" >> "$LOG"
     else
-      timeout -k 10 860 python bench_kernels.py --inner --legs-dir BENCH_KERNELS_LEGS_r5 \
-        > BENCH_KERNELS_TPU_r5.json 2>> tpu_watch.out
+      timeout -k 10 "$KERN_TO" bash -c "$KERN_CMD" > "$KERN_JSON" 2>> "$LOG"
       rc2=$?
-      echo "$(date +%H:%M:%S) bench_kernels.py done rc=$rc2" >> tpu_watch.out
-      if [ $rc2 -ne 0 ] || [ ! -s BENCH_KERNELS_TPU_r5.json ]; then
-        python -m apex_tpu.utils.bench_legs BENCH_KERNELS_LEGS_r5 --kind kernels \
-          > BENCH_KERNELS_TPU_r5.json 2>> tpu_watch.out
-        echo "$(date +%H:%M:%S) bench_kernels.py FAILED mid-run; assembled partial from legs, resuming probe loop" >> tpu_watch.out
-        sleep 300
+      echo "$(date +%H:%M:%S) bench_kernels.py done rc=$rc2" >> "$LOG"
+      if [ $rc2 -ne 0 ] || [ ! -s "$KERN_JSON" ]; then
+        $ASSEMBLE_CMD "$KERN_LEGS" --kind kernels > "$KERN_JSON" 2>> "$LOG"
+        echo "$(date +%H:%M:%S) bench_kernels.py FAILED mid-run; assembled partial from legs, resuming probe loop" >> "$LOG"
+        sleep "$SLEEP"
+        continue
+      fi
+      if ! complete "$KERN_JSON"; then
+        echo "$(date +%H:%M:%S) bench_kernels.py produced a non-TPU/partial artifact; resuming probe loop" >> "$LOG"
+        sleep "$SLEEP"
         continue
       fi
     fi
+    # both complete: apply measured winners to the tuning profile so the
+    # framework's defaults match the chip even if nobody is watching
+    bash -c "$APPLY_CMD" >> "$LOG" 2>&1
     # marker LAST: it invites the interactive session to kill this script
     # and take the (single-client) tunnel — must not race the bench runs
     date -u +%Y-%m-%dT%H:%M:%SZ > TUNNEL_LIVE
     exit 0
   fi
-  echo "$(date +%H:%M:%S) probe $i: $(printf '%s' "$out" | tr '\n' ' ')" >> tpu_watch.out
-  sleep 300
+  echo "$(date +%H:%M:%S) probe $i: $(printf '%s' "$out" | tr '\n' ' ')" >> "$LOG"
+  sleep "$SLEEP"
 done
-echo "gave up after 144 probes" >> tpu_watch.out
+echo "gave up after $N_PROBES probes" >> "$LOG"
 exit 1
