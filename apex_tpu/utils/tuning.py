@@ -82,11 +82,19 @@ def get_on_tpu(key: str, default: Any = None) -> Any:
     would route interpret-mode Pallas (orders of magnitude slower) or
     flip state layouts the measurements say nothing about.  This is the
     accessor every runtime default should use; plain :func:`get` is for
-    backend-independent values and tooling."""
+    backend-independent values and tooling.
+
+    Side-effect-free: if no jax backend is initialized yet, this
+    returns ``default`` WITHOUT initializing one — consulting a tuning
+    knob (e.g. constructing an optimizer before
+    ``jax.distributed.initialize``) must never force early backend
+    bring-up.  Values read at trace time (the kernel-choice knobs) are
+    unaffected: tracing implies an initialized backend."""
+    from .platform import backends_initialized
     import jax
     try:
-        if jax.default_backend() != "tpu":
+        if not backends_initialized() or jax.default_backend() != "tpu":
             return default
-    except Exception:  # backend not initializable: stay on built-ins
+    except Exception:  # backend probe failed: stay on built-ins
         return default
     return _load().get(key, default)

@@ -464,6 +464,15 @@ def _inner_main(legs_dir=None):
     """Run the benchmark on the AMBIENT backend and print the JSON line.
     Raises/hangs are the outer process's problem — that is the point;
     with ``legs_dir`` every completed leg survives on disk regardless."""
+    import os
+    if legs_dir is None and jax.default_backend() == "tpu":
+        # TPU runs always flush legs (default dir next to this script):
+        # chip time is precious and the tunnel can wedge mid-run — a
+        # driver-invoked run gets the same crash-safety as the watcher.
+        # CPU runs stay leg-less (nothing worth protecting, and a CPU
+        # record must never touch the TPU legs dir).
+        legs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_LEGS_r5")
     deadline = time.monotonic() + 540.0
     print(json.dumps(run_bench(lambda: deadline - time.monotonic(),
                                legs_dir=legs_dir)))

@@ -201,6 +201,86 @@ def bench_flash_autotune(results, on_tpu, flush=lambda *a: None):
               merge=True)
 
 
+def bench_flash_vmem_probe(results, on_tpu):
+    """Validate the flash VMEM footprint model against real Mosaic
+    compiles (round-4 verdict weak #4: ``_clamp_blocks``' estimate had
+    never been checked on silicon).  For a ladder of (bq, bk) configs at
+    S=2048 D=64 fwd and bwd, record the model's bytes next to whether
+    Mosaic actually compiles at that config; the interesting rows are
+    disagreements — a compile failure the model called "fits" means the
+    constant terms are too optimistic, compiles far above the ~16 MiB
+    line mean it over-reserves.  TPU-only (interpret mode always
+    'compiles')."""
+    if not on_tpu:
+        results["flash_vmem_probe"] = {"skipped": "cpu (interpret mode)"}
+        return
+    from apex_tpu.contrib.multihead_attn.flash import (_flash_fwd,
+                                                      flash_attention,
+                                                      vmem_estimate)
+
+    B, H, S, D = 2, 4, 2048, 64
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B * H, S, D), jnp.bfloat16) / np.sqrt(D)
+    k = jax.random.normal(key, (B * H, S, D), jnp.bfloat16)
+    v = jax.random.normal(key, (B * H, S, D), jnp.bfloat16)
+    bias = jnp.zeros((1, 1, S), jnp.float32)
+    vmem_cap = 16 * 2 ** 20
+
+    rows = {}
+    for bwd in (False, True):
+        for bq, bk in ((256, 512), (512, 1024), (1024, 2048), (2048, 2048)):
+            import os
+            est = vmem_estimate(bq, bk, D, 2, bias_per_q=False, bwd=bwd)
+            prior_pins = {k: os.environ.get(k)
+                          for k in ("APEX_TPU_FLASH_BLOCK_Q",
+                                    "APEX_TPU_FLASH_BLOCK_K")}
+            if bwd:
+                # the public grad path reads blocks from the env pins at
+                # trace time; pinned values are compiled EXACTLY (no
+                # clamp), which is the point of the probe
+                os.environ["APEX_TPU_FLASH_BLOCK_Q"] = str(bq)
+                os.environ["APEX_TPU_FLASH_BLOCK_K"] = str(bk)
+                fn = jax.jit(lambda q_: jax.grad(lambda x: jnp.sum(
+                    flash_attention(x, k, v, bias, heads=H)
+                    .astype(jnp.float32)))(q_))
+                args = (q,)
+            else:
+                fn = jax.jit(functools.partial(
+                    _flash_fwd, causal=False, dropout_rate=0.0, seed=0,
+                    heads=H, bq=bq, bk=bk))
+                args = (q, k, v, bias)
+            try:
+                fn.lower(*args).compile()
+                compiled = True
+                err = None
+            except Exception as e:
+                compiled = False
+                err = repr(e)[:160]
+            finally:
+                if bwd:
+                    # restore the caller's own pins, don't just pop them
+                    # (pk/pv: k and v name the attention tensors here)
+                    for pk, pv in prior_pins.items():
+                        if pv is None:
+                            os.environ.pop(pk, None)
+                        else:
+                            os.environ[pk] = pv
+            rec = {"est_mb": round(est / 2 ** 20, 2),
+                   "model_fits_16mb": est <= vmem_cap,
+                   "compiled": compiled}
+            if err:
+                rec["error"] = err
+            rec["agrees"] = rec["model_fits_16mb"] == compiled
+            rows[f"{'bwd' if bwd else 'fwd'}_{bq}x{bk}"] = rec
+            _log(f"vmem_probe {'bwd' if bwd else 'fwd'} {bq}x{bk}: "
+                 f"est {rec['est_mb']}MB fits={rec['model_fits_16mb']} "
+                 f"compiled={compiled}")
+            gc.collect()
+    results["flash_vmem_probe"] = {
+        "shape": f"S{S} D{D} esz2", "rows": rows,
+        "all_agree": all(r["agrees"] for r in rows.values())}
+
+
 def bench_xentropy(results, on_tpu):
     from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
 
@@ -364,7 +444,7 @@ def run(budget_left=lambda: 1e9, legs_dir=None):
     done_keys: set = set()
     for fn in (bench_attention, bench_xentropy, bench_layer_norm,
                bench_mlp, bench_multi_tensor, bench_flash_autotune,
-               bench_attn_seq_sweep):
+               bench_attn_seq_sweep, bench_flash_vmem_probe):
         if budget_left() < 40:
             _log(f"budget exhausted before {fn.__name__}")
             break
@@ -390,6 +470,11 @@ from apex_tpu.utils.bench_legs import argval as _argval
 
 
 def _inner_main(legs_dir=None):
+    import os
+    if legs_dir is None and jax.default_backend() == "tpu":
+        # TPU runs always flush legs (see bench.py._inner_main)
+        legs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_KERNELS_LEGS_r5")
     deadline = time.monotonic() + 700.0
     print(json.dumps(run(lambda: deadline - time.monotonic(),
                          legs_dir=legs_dir)))

@@ -28,6 +28,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_PROBES": "5",
            "APEX_WATCH_BENCH_TO": "30",
            "APEX_WATCH_KERN_TO": "30",
+           "APEX_WATCH_TRAIN_TO": "30",
+           "APEX_WATCH_TRAIN_CMD": "",
            "APEX_WATCH_APPLY_CMD": "echo applied",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
@@ -110,10 +112,26 @@ def test_skip_already_complete_bench(tmp_path):
     })
     assert r.returncode == 0, (r.stdout, r.stderr, log)
     assert "bench.py already complete; skipping" in log
-    assert "SHOULD-NOT-RUN" not in log
-    # artifact untouched
-    assert json.loads((tmp_path / "BENCH_TPU_r5.json").read_text())[
-        "value"] == 1.0
+    # artifact untouched — had the bench wrongly run, its stdout would
+    # have replaced the artifact (the > redirect), not the log
+    artifact = (tmp_path / "BENCH_TPU_r5.json").read_text()
+    assert "SHOULD-NOT-RUN" not in artifact
+    assert json.loads(artifact)["value"] == 1.0
+
+
+def test_train_stage_runs_after_benches_and_never_blocks_exit(tmp_path):
+    """Stage 3 (training-on-hardware proof) runs once after both benches
+    complete; its failure must not forfeit the captured artifacts."""
+    r, log = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_TRAIN_CMD": "echo 'Step 1 Loss 2.0'; exit 7",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    assert (tmp_path / "TUNNEL_LIVE").exists()   # train rc=7 didn't block
+    assert "train run (save+resume) done rc=7" in log
+    assert "Step 1 Loss 2.0" in (tmp_path / "TRAIN_LOG_r5.txt").read_text()
 
 
 def test_cpu_fallback_artifact_does_not_end_the_mission(tmp_path):

@@ -61,8 +61,11 @@ def test_corrupt_profile_is_ignored(tmp_path, monkeypatch):
 @pytest.fixture
 def fake_tpu(monkeypatch):
     """Profile values only apply on the TPU backend (get_on_tpu); fake
-    it for the consumer tests — nothing here executes a kernel."""
+    it for the consumer tests — nothing here executes a kernel.
+    get_on_tpu is also side-effect-free (returns the default when no
+    backend is initialized yet), so initialize the CPU backend first."""
     import jax
+    jax.devices()                      # ensure backends_initialized()
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
 
 
@@ -132,6 +135,28 @@ def test_bert_config_attn_from_profile(profile, fake_tpu):
                              attn_impl="default").attn_impl == "default"
     profile({})
     assert bert_large_config(num_layers=2).attn_impl == "default"
+
+
+def test_get_on_tpu_is_side_effect_free_pre_init():
+    """Consulting a tuning knob (e.g. constructing DistributedFusedAdam
+    before jax.distributed.initialize) must not force backend bring-up
+    (code-review r5, third pass)."""
+    code = (
+        "from apex_tpu.utils import tuning\n"
+        "from apex_tpu.utils.platform import backends_initialized\n"
+        "assert not backends_initialized()\n"
+        "assert tuning.get_on_tpu('zero_impl', 'xla') == 'xla'\n"
+        "assert not backends_initialized(), 'get_on_tpu initialized jax!'\n"
+        "from apex_tpu.contrib.optimizers import DistributedFusedAdam\n"
+        "assert DistributedFusedAdam(lr=1e-3).impl == 'xla'\n"
+        "assert not backends_initialized(), 'optimizer ctor initialized jax!'\n"
+        "print('SIDE-EFFECT-FREE')\n")
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": ROOT, "JAX_PLATFORMS": "cpu"},
+        timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "SIDE-EFFECT-FREE" in r.stdout
 
 
 # ---------------------------------------------------------------------------
@@ -231,3 +256,15 @@ def test_cli_writes_profile_and_notes(tmp_path):
     assert prof["_provenance"]["bench"] == "b.json"
     assert "| knob | decision |" in r.stdout
     assert "Measured winners applied" in notes.read_text()
+    # re-running (documented as safe) REPLACES the section, no duplicates
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "apply_perf_results.py"),
+         "--bench", str(bench), "--kernels", str(kern), "--out", str(out),
+         "--notes", str(notes)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    assert r2.returncode == 0, r2.stderr
+    txt = notes.read_text()
+    assert txt.count("## 7. Measured winners applied") == 1
+    assert txt.startswith("# notes")            # preamble preserved

@@ -187,11 +187,22 @@ def main(argv=None):
 
     if args.notes:
         stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
-        with open(args.notes, "a") as f:
-            f.write(f"\n## 7. Measured winners applied ({stamp})\n\n"
+        marker = "\n## 7. Measured winners applied"
+        try:
+            with open(args.notes) as f:
+                content = f.read()
+        except OSError:
+            content = ""
+        # re-runs REPLACE the section (it is always the file's tail)
+        # instead of accreting duplicate identically-numbered headings
+        idx = content.find(marker)
+        if idx != -1:
+            content = content[:idx]
+        with open(args.notes, "w") as f:
+            f.write(f"{content}{marker} ({stamp})\n\n"
                     f"{table}\n\nProfile: `apex_tpu/tuned_defaults.json` "
                     f"(every knob consults it — utils/tuning.py).\n")
-        print(f"[apply_perf] appended results table to {args.notes}",
+        print(f"[apply_perf] wrote results table to {args.notes}",
               file=sys.stderr)
     return 0
 

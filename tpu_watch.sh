@@ -34,6 +34,13 @@ BENCH_CMD=${APEX_WATCH_BENCH_CMD:-"python bench.py --inner --legs-dir $BENCH_LEG
 KERN_CMD=${APEX_WATCH_KERN_CMD:-"python bench_kernels.py --inner --legs-dir $KERN_LEGS"}
 ASSEMBLE_CMD=${APEX_WATCH_ASSEMBLE_CMD:-"python -m apex_tpu.utils.bench_legs"}
 APPLY_CMD=${APEX_WATCH_APPLY_CMD:-"python tools/apply_perf_results.py --notes PERF_NOTES.md"}
+# stage 3 (best-effort): a REAL training run on the chip with a
+# checkpoint save/resume cycle — loss must fall, Prec@1 must move
+# (round-4 verdict item 8's unattended capture).  Failure or timeout
+# here never forfeits the bench artifacts already captured.
+TRAIN_CMD=${APEX_WATCH_TRAIN_CMD:-"python examples/imagenet/main_amp.py --arch resnet50 --batch-size 64 --steps 200 --epochs 1 --validate 50 --opt-level O2 --save ckpt_watch_r5 && python examples/imagenet/main_amp.py --arch resnet50 --batch-size 64 --steps 100 --epochs 1 --validate 50 --opt-level O2 --resume ckpt_watch_r5"}
+TRAIN_LOG=${APEX_WATCH_TRAIN_LOG:-TRAIN_LOG_r5.txt}
+TRAIN_TO=${APEX_WATCH_TRAIN_TO:-1200}
 BENCH_TO=${APEX_WATCH_BENCH_TO:-700}
 KERN_TO=${APEX_WATCH_KERN_TO:-860}
 
@@ -56,7 +63,7 @@ for i in $(seq 1 "$N_PROBES"); do
       echo "$(date +%H:%M:%S) bench.py done rc=$rc1" >> "$LOG"
       if [ $rc1 -ne 0 ] || [ ! -s "$BENCH_JSON" ]; then
         # mid-run wedge: completed legs still settle what they can
-        $ASSEMBLE_CMD "$BENCH_LEGS" --kind bench > "$BENCH_JSON" 2>> "$LOG"
+        bash -c "$ASSEMBLE_CMD $BENCH_LEGS --kind bench" > "$BENCH_JSON" 2>> "$LOG"
         echo "$(date +%H:%M:%S) bench.py FAILED mid-run; assembled partial from legs, resuming probe loop" >> "$LOG"
         sleep "$SLEEP"
         continue
@@ -77,7 +84,7 @@ for i in $(seq 1 "$N_PROBES"); do
       rc2=$?
       echo "$(date +%H:%M:%S) bench_kernels.py done rc=$rc2" >> "$LOG"
       if [ $rc2 -ne 0 ] || [ ! -s "$KERN_JSON" ]; then
-        $ASSEMBLE_CMD "$KERN_LEGS" --kind kernels > "$KERN_JSON" 2>> "$LOG"
+        bash -c "$ASSEMBLE_CMD $KERN_LEGS --kind kernels" > "$KERN_JSON" 2>> "$LOG"
         echo "$(date +%H:%M:%S) bench_kernels.py FAILED mid-run; assembled partial from legs, resuming probe loop" >> "$LOG"
         sleep "$SLEEP"
         continue
@@ -89,8 +96,19 @@ for i in $(seq 1 "$N_PROBES"); do
       fi
     fi
     # both complete: apply measured winners to the tuning profile so the
-    # framework's defaults match the chip even if nobody is watching
+    # framework's defaults match the chip even if nobody is watching.
+    # Log its rc — a silent apply failure would mean the
+    # flip-defaults-to-winners loop never closed while the watcher
+    # reports success (the bench artifacts themselves are still the
+    # mission, so a failed apply does not forfeit the exit).
     bash -c "$APPLY_CMD" >> "$LOG" 2>&1
+    rc_apply=$?
+    echo "$(date +%H:%M:%S) apply_perf_results done rc=$rc_apply" >> "$LOG"
+    if [ -n "$TRAIN_CMD" ] && [ ! -s "$TRAIN_LOG" ]; then
+      timeout -k 10 "$TRAIN_TO" bash -c "$TRAIN_CMD" > "$TRAIN_LOG" 2>&1
+      rc3=$?   # capture BEFORE the $(date) substitution resets $?
+      echo "$(date +%H:%M:%S) train run (save+resume) done rc=$rc3" >> "$LOG"
+    fi
     # marker LAST: it invites the interactive session to kill this script
     # and take the (single-client) tunnel — must not race the bench runs
     date -u +%Y-%m-%dT%H:%M:%SZ > TUNNEL_LIVE
