@@ -186,16 +186,28 @@ def assemble(legs_dir: str, kind: str = "bench") -> dict:
         # leg (or a uniform non-mixed run, where top-level `backend`
         # already labels it)
         if backend != "mixed" or head_rec.get("backend") == "tpu":
-            xla_ms = head.get("xla_impl_ms")
-            fused_ms = head.get("fused_flat_impl_ms")
-            done = [m for m in (xla_ms, fused_ms)
+            # best-vs-best across dtype-matched pairs, mirroring
+            # bench.py's headline logic (fp32 impls vs optax-fp32;
+            # flat-bf16 vs optax-bf16).  A pair missing its baseline
+            # (wedge between the impl and its optax twin) must not win
+            # `value` and silently drop vs_baseline when a FULL pair
+            # exists — prefer the best full pair; fall back to the best
+            # baseline-less impl only when no pair completed.
+            base = head.get("optax_baseline_ms")
+            pairs = [(head.get("xla_impl_ms"), base),
+                     (head.get("fused_flat_impl_ms"), base),
+                     (head.get("fused_flat_bf16grads_ms"),
+                      head.get("optax_bf16grads_ms"))]
+            done = [(m, b) for m, b in pairs
                     if isinstance(m, (int, float))]
-            if done:
-                value = min(done)
-                base = head.get("optax_baseline_ms")
-                if (isinstance(base, (int, float))
-                        and head_rec.get("backend") == "tpu"):
-                    vs_baseline = round(base / value, 3)
+            full = [(m, b) for m, b in done
+                    if isinstance(b, (int, float))]
+            if full:
+                value, vbase = min(full, key=lambda p: p[0])
+                if head_rec.get("backend") == "tpu":
+                    vs_baseline = round(vbase / value, 3)
+            elif done:
+                value = min(m for m, _ in done)
     for name, rec in legs.items():
         if name != "headline":
             detail[name] = tag(rec, rec.get("data"))

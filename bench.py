@@ -121,10 +121,19 @@ def time_apex_fused_flat(make_params, grads, grad_dtype=None):
     return ms
 
 
-def time_optax(make_params, grads):
+def time_optax(make_params, grads, grad_dtype=None):
+    """``grad_dtype=bfloat16`` is the dtype-matched baseline for the
+    flat engine's bf16-grads case: same optax recipe fed the same
+    half-width gradients a bf16 backward would produce, so the bf16
+    comparison is apples-to-apples (round-4 verdict: the 23.0 ms flat
+    number must not be credited against an fp32-grads baseline)."""
     import optax
     ox = optax.chain(optax.clip_by_global_norm(1.0),
                      optax.lamb(1e-3, weight_decay=0.01))
+    if grad_dtype is not None:
+        grads = jax.jit(lambda g: jax.tree_util.tree_map(
+            lambda x: x.astype(grad_dtype), g))(grads)
+        _sync(grads)
     params = make_params()
     state = jax.jit(ox.init)(params)
 
@@ -153,13 +162,19 @@ V5E_PEAK_FLOPS = HW_CEILINGS["tpu"]["peak_flops"]   # 197 bf16 TFLOP/s
 V5E_PEAK_BYTES = HW_CEILINGS["tpu"]["peak_bw"]      # 819 GB/s HBM
 
 
-def _roofline(jitted, args, step_s, on_tpu):
+def _roofline(jitted, args, step_s, on_tpu, analytic_flops=None):
     """MFU + HBM utilization for a timed jitted step, from XLA's compiled
     cost analysis (round-3 verdict item 9: quantify 'fast' as
     achieved-vs-roofline, not just ms).  TPU-only — the CPU fallback's
-    roofline is not 197 TFLOP/s and a fake MFU would mislead."""
+    roofline is not 197 TFLOP/s and a fake MFU would mislead.
+
+    ``analytic_flops``: model-formula FLOPs/step fallback — the r5 TPU
+    capture showed ``Lowered.cost_analysis()`` can return no flops/bytes
+    keys on the axon backend, which silently dropped the MFU fields the
+    verdict asked for; the analytic number is labelled as such."""
     if not on_tpu or not step_s:
         return {}
+    out = {}
     try:
         from apex_tpu.pyprof.prof import _first
         # Lowered.cost_analysis() runs on the HLO without a backend
@@ -169,7 +184,6 @@ def _roofline(jitted, args, step_s, on_tpu):
         ca = jitted.lower(*args).cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
-        out = {}
         # cost_analysis key names drift across jax versions — use pyprof's
         # alias-aware reader instead of a one-spelling get()
         fl = _first(ca, "flops")
@@ -179,9 +193,12 @@ def _roofline(jitted, args, step_s, on_tpu):
         if by:
             out["hbm_util_pct"] = round(
                 100.0 * by / step_s / V5E_PEAK_BYTES, 2)
-        return out
     except Exception as e:  # cost analysis is best-effort
-        return {"roofline_error": repr(e)[:100]}
+        out["roofline_error"] = repr(e)[:100]
+    if "mfu_pct" not in out and analytic_flops:
+        out["mfu_analytic_pct"] = round(
+            100.0 * analytic_flops / step_s / V5E_PEAK_FLOPS, 2)
+    return out
 
 
 def bench_rn50(on_tpu):
@@ -258,8 +275,79 @@ def _bench_rn50_at(on_tpu, batch):
            "step_ms": round(step_s * 1e3, 2),
            "model": "resnet50" if on_tpu else "resnet18"}
     out.update(_roofline(train_step, (state, bn_state, images, labels),
-                         step_s, on_tpu))
+                         step_s, on_tpu,
+                         analytic_flops=_RN50_TRAIN_FLOPS_PER_IMAGE * batch))
     return out
+
+
+# ResNet-50 @224: ~4.1 GFLOP forward (MAC=2), train step ~3x forward
+# (bwd ~2x fwd) — the standard analytic count, used only when XLA's
+# cost_analysis yields nothing (labelled mfu_analytic_pct)
+_RN50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
+
+
+def bench_rn50_native_baseline(on_tpu, batch):
+    """Same-harness native-JAX baseline for the rn50 leg (round-4 verdict
+    item 4): what a JAX user runs WITHOUT apex_tpu — fp32 params, weights
+    cast to bf16 in the loss (the idiomatic mixed-precision recipe, no
+    loss scaling needed for bf16), plain ``optax.adam``.  The ratio
+    ours/baseline makes BASELINE's ">=90% of native baseline step time"
+    target checkable from the bench JSON alone."""
+    import optax
+
+    cfg = (resnet50_config if on_tpu else resnet18_config)(
+        dtype=jnp.bfloat16)
+    _log(f"rn50 native-optax baseline: batch={batch}")
+    params, bn_state = jax.jit(
+        lambda: resnet_init(jax.random.PRNGKey(0), cfg))()
+    ox = optax.adam(1e-3)
+    opt_state = jax.jit(ox.init)(params)
+
+    images = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    def _half(p):
+        # conv/fc kernels bf16, 1-D leaves (bn scale/bias, fc bias) fp32 —
+        # the same precision split amp O2 keeps (keep_batchnorm_fp32)
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16) if a.ndim >= 2 else a, p)
+
+    @jax.jit
+    def train_step(params, opt_state, bn_state, images, labels):
+        def loss_fn(p):
+            logits, new_bn = resnet_apply(_half(p), bn_state, images, cfg,
+                                          train=True)
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(jnp.take_along_axis(lp, labels[:, None],
+                                                 axis=1)), new_bn
+
+        (loss, new_bn), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = ox.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, new_bn, loss
+
+    _log("compiling rn50 baseline step ...")
+    params, opt_state, bn_state, loss = train_step(params, opt_state,
+                                                   bn_state, images, labels)
+    _sync(loss)
+    _log("timing rn50 baseline step ...")
+
+    def run(n, params, opt_state, bn_state):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            params, opt_state, bn_state, loss = train_step(
+                params, opt_state, bn_state, images, labels)
+        _sync(loss)
+        return time.perf_counter() - t0, params, opt_state, bn_state
+
+    t1, params, opt_state, bn_state = run(2, params, opt_state, bn_state)
+    t2, params, opt_state, bn_state = run(8, params, opt_state, bn_state)
+    step_s = (t2 - t1) / 6
+    ips = batch / step_s
+    _log(f"rn50 baseline: {step_s*1e3:.1f} ms/step, {ips:.1f} images/sec")
+    return {"images_per_sec": round(ips, 1), "batch": batch,
+            "step_ms": round(step_s * 1e3, 2)}
 
 
 def bench_bert_e2e(on_tpu):
@@ -353,7 +441,12 @@ def _bench_bert_e2e_at(on_tpu, cfg, batch, seq):
            "model": ("bert-large-24L-flash-remat" if on_tpu
                      else "bert-tiny-cpu"),
            "n_params": n_params}
-    out.update(_roofline(train_step, (state,), ms / 1e3, on_tpu))
+    # 6ND fwd+bwd, +2ND for the remat'd second forward (attention's
+    # seq^2 term omitted — labelled analytic, a lower bound)
+    tokens = batch * seq
+    flops = (8 if cfg.remat else 6) * n_params * tokens
+    out.update(_roofline(train_step, (state,), ms / 1e3, on_tpu,
+                         analytic_flops=flops))
     return out
 
 
@@ -394,14 +487,28 @@ def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     flush("headline", head, merge=True)
     base_ms = time_optax(make_params, grads)
     head["optax_baseline_ms"] = round(base_ms, 3)
+    flush("headline", head, merge=True)
+    # dtype-matched baseline for the bf16-grads pair: optax fed the same
+    # bf16 gradients (r5: the 23.0 ms flat-bf16 measurement needs an
+    # apples-to-apples denominator, not the fp32 one)
+    base_bf16_ms = time_optax(make_params, grads, grad_dtype=jnp.bfloat16)
+    head["optax_bf16grads_ms"] = round(base_bf16_ms, 3)
     del grads
     gc.collect()
-    # headline stays apples-to-apples with the fp32-grads optax baseline;
-    # the bf16-grads flat number (the O5 flat-native case) is reported but
-    # never hidden inside `value`
-    best_ms = min(xla_ms, fused_ms)
-    winner = "fused_flat" if fused_ms <= xla_ms else "xla"
+    # `value`/`vs_baseline` are best-vs-best across dtype-matched pairs:
+    # the fp32 pair (xla|fused vs optax-fp32) and the bf16-grads pair
+    # (fused-bf16 vs optax-bf16) — "is apex faster than what a JAX user
+    # would otherwise run", with every component number still reported
+    pairs = {
+        "xla": (xla_ms, base_ms),
+        "fused_flat": (fused_ms, base_ms),
+        "fused_flat_bf16grads": (fused_bf16_ms, base_bf16_ms),
+    }
+    winner = min(pairs, key=lambda k: pairs[k][0])
+    best_ms, best_base_ms = pairs[winner]
     head["winner"] = winner
+    head["vs_baseline_fp32_pair"] = round(base_ms / min(xla_ms, fused_ms), 3)
+    head["vs_baseline_bf16_pair"] = round(base_bf16_ms / fused_bf16_ms, 3)
     head["complete"] = True
     flush("headline", head, merge=True)
 
@@ -422,6 +529,21 @@ def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     else:
         _log("skipping rn50 leg (budget)")
     gc.collect()
+    # native-optax rn50 baseline at the SAME batch the apex leg used —
+    # the ratio answers BASELINE's ">=90% of native baseline" directly
+    if budget_left() > 100 and isinstance(detail.get(rn50_key), dict) \
+            and "images_per_sec" in detail[rn50_key]:
+        try:
+            ours = detail[rn50_key]
+            base = bench_rn50_native_baseline(on_tpu, ours["batch"])
+            ours["native_optax_baseline"] = base
+            ours["vs_native_baseline"] = round(
+                ours["images_per_sec"] / base["images_per_sec"], 3)
+        except Exception as err:
+            detail[rn50_key]["native_optax_baseline"] = {
+                "error": repr(err)[:200]}
+        flush(rn50_key, detail[rn50_key], merge=True)
+    gc.collect()
     if budget_left() > 100:
         try:
             detail["bert_e2e"] = bench_bert_e2e(on_tpu)
@@ -432,18 +554,20 @@ def run_bench(budget_left=lambda: 1e9, legs_dir=None):
         _log("skipping bert e2e leg (budget)")
 
     if on_tpu:
-        # the flat optimizer step is bandwidth-bound: 7 flat fp32 buffers
-        # (read g/p/m/v, write p/m/v) per step — achieved HBM GB/s vs the
-        # 819 GB/s v5e roofline quantifies how close to optimal it runs
+        # the flat optimizer step is bandwidth-bound: read g/p/m/v, write
+        # p/m/v per step (26 B/param with bf16 grads, 28 B/param fp32) —
+        # achieved HBM GB/s vs the 819 GB/s v5e roofline quantifies how
+        # close to optimal the winning step runs
+        bytes_per_param = 26 if winner.endswith("bf16grads") else 28
         detail["flat_step_hbm_gbps"] = round(
-            7 * 4 * n_params / (best_ms / 1e3) / 1e9, 1)
+            bytes_per_param * n_params / (best_ms / 1e3) / 1e9, 1)
         detail["hbm_roofline_gbps"] = V5E_PEAK_BYTES / 1e9
 
     # vs_baseline from a CPU fallback says nothing about the product
     # thesis (round-4 verdict weak #3): emit null at top level so a
     # driver skim can't over-credit a proxy ratio; the CPU ratio stays
     # available — explicitly labelled — in the detail
-    vs = round(base_ms / best_ms, 3)
+    vs = round(best_base_ms / best_ms, 3)
     if not on_tpu:
         detail["vs_baseline_cpu_proxy"] = vs
 
@@ -488,9 +612,19 @@ def main():
     failure or timeout the parent neutralizes the tunnel and re-runs on
     CPU in-process, so a real number is still recorded.
     """
+    import os
     import subprocess
 
     legs_dir = _argval(sys.argv, "--legs-dir")
+    if legs_dir is None:
+        # driver-invoked runs get the standard legs dir: the TPU inner
+        # flushes there (crash-safety), and — critically — the CPU
+        # fallback below then surfaces any PREVIOUSLY captured TPU legs
+        # as tpu_partial_legs.  Without this default, a driver run during
+        # a wedge would bury the round's real on-chip numbers (r5: the
+        # tunnel flaps; the captured window must outlive it).
+        legs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_LEGS_r5")
     deadline = time.monotonic() + 620.0   # > inner's 540s budget, and the
     # CPU fallback below has its own 240s window if the inner dies early
     attempt_errs = []

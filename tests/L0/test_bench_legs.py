@@ -254,7 +254,11 @@ def _stub_timings(bench, monkeypatch, wedge_at=None):
     for name, v in vals.items():
         monkeypatch.setattr(bench, name, mk(name, v))
     monkeypatch.setattr(bench, "bench_rn50",
-                        mk("bench_rn50", {"images_per_sec": 1.0}))
+                        mk("bench_rn50",
+                           {"images_per_sec": 1.0, "batch": 4}))
+    monkeypatch.setattr(bench, "bench_rn50_native_baseline",
+                        mk("bench_rn50_native_baseline",
+                           {"images_per_sec": 0.8, "batch": 4}))
     monkeypatch.setattr(bench, "bench_bert_e2e",
                         mk("bench_bert_e2e", {"step_ms": 2.0}))
 
@@ -297,7 +301,12 @@ def test_run_bench_full_flush_sequence(tmp_path, monkeypatch):
     assert payload["vs_baseline"] is None          # CPU in tests
     assert payload["detail"]["vs_baseline_cpu_proxy"] == pytest.approx(
         29.4 / 19.0, abs=1e-3)
-    assert payload["detail"][rn50_key] == {"images_per_sec": 1.0}
+    rn50 = payload["detail"][rn50_key]
+    assert rn50["images_per_sec"] == 1.0
+    # the same-batch native-optax baseline rides inside the rn50 leg with
+    # the ready-made ratio (BASELINE's ">=90% of native" check)
+    assert rn50["native_optax_baseline"]["images_per_sec"] == 0.8
+    assert rn50["vs_native_baseline"] == pytest.approx(1.25, abs=1e-3)
 
 
 def test_run_bench_without_legs_dir_still_returns_payload(monkeypatch):

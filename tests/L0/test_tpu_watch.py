@@ -14,8 +14,11 @@ ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 WATCH = os.path.join(ROOT, "tpu_watch.sh")
 
+# a bench artifact is only skip-complete when it carries the r5-extras
+# marker (optax_bf16grads_ms) — a pre-extras capture must be re-run
 COMPLETE_BENCH = json.dumps({"metric": "m", "value": 1.0,
-                             "backend": "tpu", "detail": {}})
+                             "backend": "tpu",
+                             "detail": {"optax_bf16grads_ms": 2.0}})
 COMPLETE_KERN = json.dumps({"metric": "k", "backend": "tpu",
                             "kernels": {}})
 
@@ -70,7 +73,7 @@ echo '{COMPLETE_BENCH}'
         "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
     })
     assert r.returncode == 0, (r.stdout, r.stderr, log)
-    assert "FAILED mid-run; assembled partial" in log
+    assert "re-run failed; kept best artifact" in log
     assert (tmp_path / "TUNNEL_LIVE").exists()
     assert "applied" in log                       # apply ran before exit
     final = json.loads((tmp_path / "BENCH_TPU_r5.json").read_text())
@@ -111,7 +114,7 @@ def test_skip_already_complete_bench(tmp_path):
         "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
     })
     assert r.returncode == 0, (r.stdout, r.stderr, log)
-    assert "bench.py already complete; skipping" in log
+    assert "bench.py already complete (incl. extras); skipping" in log
     # artifact untouched — had the bench wrongly run, its stdout would
     # have replaced the artifact (the > redirect), not the log
     artifact = (tmp_path / "BENCH_TPU_r5.json").read_text()
@@ -119,9 +122,11 @@ def test_skip_already_complete_bench(tmp_path):
     assert json.loads(artifact)["value"] == 1.0
 
 
-def test_train_stage_runs_after_benches_and_never_blocks_exit(tmp_path):
-    """Stage 3 (training-on-hardware proof) runs once after both benches
-    complete; its failure must not forfeit the captured artifacts."""
+def test_train_failure_never_blocks_later_stages(tmp_path):
+    """Stage 2 (training-on-hardware proof) runs after the kernel bench;
+    its failure must not forfeit the bench stages nor the exit — the
+    failed log is renamed so a later window could retry and a partial
+    log is never mistaken for a pass."""
     r, log = run_watch(tmp_path, {
         "APEX_WATCH_PROBE_CMD": "true",
         "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
@@ -131,7 +136,45 @@ def test_train_stage_runs_after_benches_and_never_blocks_exit(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr, log)
     assert (tmp_path / "TUNNEL_LIVE").exists()   # train rc=7 didn't block
     assert "train run (save+resume) done rc=7" in log
-    assert "Step 1 Loss 2.0" in (tmp_path / "TRAIN_LOG_r5.txt").read_text()
+    assert "Step 1 Loss 2.0" in (
+        tmp_path / "TRAIN_LOG_r5_failed.txt").read_text()
+    assert not (tmp_path / "TRAIN_LOG_r5.txt").exists()
+
+
+def test_kernels_run_first_when_bench_already_complete(tmp_path):
+    """r5 stage order: the kernel bench (the only never-captured
+    artifact) runs BEFORE any bench re-run, and a complete-with-extras
+    bench artifact is not touched."""
+    (tmp_path / "BENCH_TPU_r5.json").write_text(COMPLETE_BENCH)
+    order = tmp_path / "order.log"
+    r, log = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo bench >> {order}; false",
+        "APEX_WATCH_KERN_CMD":
+            f"echo kern >> {order}; echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_TRAIN_CMD": f"echo train >> {order}",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    assert order.read_text().split() == ["kern", "train"]  # bench skipped
+    assert "bench.py already complete (incl. extras); skipping" in log
+
+
+def test_pre_extras_bench_artifact_triggers_rerun(tmp_path):
+    """A complete TPU bench artifact WITHOUT the r5-extras marker (the
+    01:01 capture) must be re-run — and a failing re-run must keep the
+    existing artifact rather than downgrade it to a partial."""
+    pre_extras = json.dumps({"metric": "m", "value": 1.0,
+                             "backend": "tpu", "detail": {}})
+    (tmp_path / "BENCH_TPU_r5.json").write_text(pre_extras)
+    r, log = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": "false",          # re-run wedges
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    })
+    assert r.returncode == 1                      # extras never captured
+    assert "re-run failed; kept best artifact" in log
+    kept = json.loads((tmp_path / "BENCH_TPU_r5.json").read_text())
+    assert kept["value"] == 1.0 and "partial" not in kept
 
 
 def test_cpu_fallback_artifact_does_not_end_the_mission(tmp_path):
@@ -146,7 +189,7 @@ def test_cpu_fallback_artifact_does_not_end_the_mission(tmp_path):
         "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
     })
     assert r.returncode == 1                      # never completed
-    assert "non-TPU/partial artifact" in log
+    assert "re-run failed; kept best artifact" in log
     assert not (tmp_path / "TUNNEL_LIVE").exists()
 
 
