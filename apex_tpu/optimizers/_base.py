@@ -67,16 +67,39 @@ def resolve(value, count):
 
 
 class FusedOptimizer:
-    """Base: handles impl selection and the flattener for the fused path."""
+    """Base: handles impl selection and the flattener for the fused path.
 
-    def __init__(self, lr, weight_decay=0.0, impl="xla"):
+    ``state_dtype`` (fused impl only, optimizers that opt in): storage
+    dtype for the m/v moment buffers.  The flat optimizer step is HBM-
+    bandwidth-bound (r5 on-chip: 23.0 ms at 334M params ~= 16 GB of
+    buffer traffic); storing moments in bf16 cuts ~2.7 GB/step (~17%) at
+    334M.  All arithmetic stays fp32 (moments are upcast at read, cast
+    back at store) — only the STORAGE narrows, the reference trade-off of
+    low-precision optimizer states.  Master params always stay fp32."""
+
+    def __init__(self, lr, weight_decay=0.0, impl="xla", state_dtype=None):
         if impl not in ("xla", "fused"):
             raise ValueError(f"impl must be 'xla' or 'fused', got {impl!r}")
+        if state_dtype is not None and impl != "fused":
+            raise ValueError("state_dtype is a flat-engine (impl='fused') "
+                             "knob; the xla impl keeps fp32 moments")
+        if state_dtype is not None and not jnp.issubdtype(
+                jnp.dtype(state_dtype), jnp.floating):
+            # an int dtype would silently truncate every stored moment
+            # toward zero and stall training with no error
+            raise ValueError(f"state_dtype must be a float dtype, got "
+                             f"{jnp.dtype(state_dtype)}")
         self.lr = lr
         self.weight_decay = weight_decay
         self.impl = impl
+        self.state_dtype = (jnp.float32 if state_dtype is None
+                            else jnp.dtype(state_dtype))
         self._flattener: Optional[TreeFlattener] = None
         self._flattener_key = None
+
+    def _store_moment(self, x):
+        """Cast an fp32-computed moment to its storage dtype (no-op fp32)."""
+        return x.astype(self.state_dtype)
 
     def flattener_for(self, params) -> TreeFlattener:
         leaves, treedef = jax.tree_util.tree_flatten(params)

@@ -27,10 +27,11 @@ class FusedAdamState(NamedTuple):
 class FusedAdam(FusedOptimizer):
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-8, adam_w_mode=True, weight_decay=0.0, amsgrad=False,
-                 set_grad_none=True, model_dtype=None, impl="xla"):
+                 set_grad_none=True, model_dtype=None, impl="xla",
+                 state_dtype=None):
         # set_grad_none: accepted for signature parity (fused_adam.py:62);
         # torch .grad-clearing plumbing with no functional analog
-        super().__init__(lr, weight_decay, impl)
+        super().__init__(lr, weight_decay, impl, state_dtype)
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant "
                                "(matches reference fused_adam.py:60).")
@@ -48,8 +49,8 @@ class FusedAdam(FusedOptimizer):
             # distinct buffers: a shared array donated twice (jit
             # donate_argnums) is an aliasing error on the TPU backend
             return FusedAdamState(jnp.zeros((), jnp.int32),
-                                  jnp.zeros((fl.total,), jnp.float32),
-                                  jnp.zeros((fl.total,), jnp.float32),
+                                  jnp.zeros((fl.total,), self.state_dtype),
+                                  jnp.zeros((fl.total,), self.state_dtype),
                                   fl.flatten(params))
         z = tree_zeros_f32(params)
         return FusedAdamState(jnp.zeros((), jnp.int32), z,
@@ -121,9 +122,12 @@ class FusedAdam(FusedOptimizer):
         p = state.master
         if not self.adam_w_mode:
             g = g + wd * p          # classic L2 (ADAM_MODE_0)
-        m = b1 * state.m + (1.0 - b1) * g
-        v = b2 * state.v + (1.0 - b2) * g * g
+        # moments may be stored narrow (state_dtype): upcast for the fp32
+        # math, cast back only at store
+        m = b1 * _f32(state.m) + (1.0 - b1) * g
+        v = b2 * _f32(state.v) + (1.0 - b2) * g * g
         u = (m * rc1) / (jnp.sqrt(v * rc2) + eps)
         if self.adam_w_mode:
             u = u + wd * p          # decoupled decay (ADAM_MODE_1)
-        return FusedAdamState(count, m, v, p - lr * u)
+        return FusedAdamState(count, self._store_moment(m),
+                              self._store_moment(v), p - lr * u)

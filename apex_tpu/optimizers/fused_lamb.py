@@ -30,8 +30,9 @@ class FusedLAMB(FusedOptimizer):
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-6, weight_decay=0.01, amsgrad=False,
                  adam_w_mode=True, grad_averaging=True, set_grad_none=True,
-                 max_grad_norm=1.0, use_nvlamb=False, impl="xla"):
-        super().__init__(lr, weight_decay, impl)
+                 max_grad_norm=1.0, use_nvlamb=False, impl="xla",
+                 state_dtype=None):
+        super().__init__(lr, weight_decay, impl, state_dtype)
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support AMSGrad "
                                "(fused_lamb.py:79).")
@@ -50,8 +51,8 @@ class FusedLAMB(FusedOptimizer):
             # m and v must be distinct buffers: a shared array donated twice
             # (jit donate_argnums) is an aliasing error on the TPU backend
             return FusedLAMBState(jnp.zeros((), jnp.int32),
-                                  jnp.zeros((fl.total,), jnp.float32),
-                                  jnp.zeros((fl.total,), jnp.float32),
+                                  jnp.zeros((fl.total,), self.state_dtype),
+                                  jnp.zeros((fl.total,), self.state_dtype),
                                   fl.flatten(params))
         return FusedLAMBState(jnp.zeros((), jnp.int32), tree_zeros_f32(params),
                               tree_zeros_f32(params))
@@ -148,8 +149,10 @@ class FusedLAMB(FusedOptimizer):
         p = state.master
         if not self.adam_w_mode:
             g = g + wd * p
-        m = b1 * state.m + beta3 * g
-        v = b2 * state.v + (1.0 - b2) * g * g
+        # moments may be stored narrow (state_dtype): upcast for the fp32
+        # math, cast back only at store
+        m = b1 * _f32(state.m) + beta3 * g
+        v = b2 * _f32(state.v) + (1.0 - b2) * g * g
         u = (m * rc1) / (jnp.sqrt(v * rc2) + eps)
         if self.adam_w_mode:
             u = u + wd * p
@@ -164,4 +167,6 @@ class FusedLAMB(FusedOptimizer):
         ratio_rows = fl.broadcast_rows(ratio)                 # (rows,)
         p_new = (p.reshape(-1, LANE)
                  - lr * ratio_rows[:, None] * u.reshape(-1, LANE))
-        return FusedLAMBState(count, m, v, p_new.reshape(p.shape))
+        return FusedLAMBState(count, self._store_moment(m),
+                              self._store_moment(v),
+                              p_new.reshape(p.shape))

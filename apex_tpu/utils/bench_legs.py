@@ -197,6 +197,8 @@ def assemble(legs_dir: str, kind: str = "bench") -> dict:
             pairs = [(head.get("xla_impl_ms"), base),
                      (head.get("fused_flat_impl_ms"), base),
                      (head.get("fused_flat_bf16grads_ms"),
+                      head.get("optax_bf16grads_ms")),
+                     (head.get("fused_flat_bf16state_ms"),
                       head.get("optax_bf16grads_ms"))]
             done = [(m, b) for m, b in pairs
                     if isinstance(m, (int, float))]

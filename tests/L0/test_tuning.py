@@ -266,5 +266,20 @@ def test_cli_writes_profile_and_notes(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
     assert r2.returncode == 0, r2.stderr
     txt = notes.read_text()
-    assert txt.count("## 7. Measured winners applied") == 1
+    assert txt.count("## 8. Measured winners applied") == 1
     assert txt.startswith("# notes")            # preamble preserved
+    # a section written under an OLD heading number (pre-r5: "## 7.") is
+    # also replaced, not accreted next to the new one
+    notes.write_text("# notes\n\n## 7. Measured winners applied (old)\n\n"
+                     "| stale | table |\n")
+    r3 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "apply_perf_results.py"),
+         "--bench", str(bench), "--kernels", str(kern), "--out", str(out),
+         "--notes", str(notes)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    assert r3.returncode == 0, r3.stderr
+    txt = notes.read_text()
+    assert "stale" not in txt
+    assert txt.count("Measured winners applied") == 1

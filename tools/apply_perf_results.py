@@ -6,7 +6,7 @@ Reads the TPU bench artifacts (``BENCH_TPU_r5.json`` +
 decision rules, and writes ``apex_tpu/tuned_defaults.json`` — the
 measured-tuning profile every tunable default consults
 (``apex_tpu/utils/tuning.py``).  Prints a markdown results table
-(the PERF_NOTES §7 record) to stdout; ``--notes FILE`` appends it there.
+(the PERF_NOTES §8 record) to stdout; ``--notes FILE`` appends it there.
 
 Decision rules (each key is only written when its evidence is present
 and TPU-backed; absent keys leave the built-in defaults untouched):
@@ -187,17 +187,20 @@ def main(argv=None):
 
     if args.notes:
         stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
-        marker = "\n## 7. Measured winners applied"
+        marker = "\n## 8. Measured winners applied"
         try:
             with open(args.notes) as f:
                 content = f.read()
         except OSError:
             content = ""
         # re-runs REPLACE the section (it is always the file's tail)
-        # instead of accreting duplicate identically-numbered headings
-        idx = content.find(marker)
-        if idx != -1:
-            content = content[:idx]
+        # instead of accreting duplicate headings — match the heading
+        # number-agnostically so a notes file written when the section
+        # was numbered differently (pre-r5: "## 7.") is still replaced
+        import re
+        m = re.search(r"\n## \d+\. Measured winners applied", content)
+        if m:
+            content = content[:m.start()]
         with open(args.notes, "w") as f:
             f.write(f"{content}{marker} ({stamp})\n\n"
                     f"{table}\n\nProfile: `apex_tpu/tuned_defaults.json` "
