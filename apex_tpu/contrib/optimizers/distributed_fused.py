@@ -52,14 +52,14 @@ import jax.numpy as jnp
 
 from ...multi_tensor_apply.flattener import TreeFlattener, LANE
 from ...multi_tensor_apply import kernels
-from ...optimizers._base import resolve
+from ...optimizers._base import resolve, resolve_state_dtype
 
 
 class ShardedAdamState(NamedTuple):
     count: jnp.ndarray        # ()
     p: jnp.ndarray            # (total/N,) fp32 master shard
-    m: jnp.ndarray            # (total/N,) fp32
-    v: jnp.ndarray            # (total/N,) fp32
+    m: jnp.ndarray            # (total/N,) state_dtype (fp32 default)
+    v: jnp.ndarray            # (total/N,) state_dtype (fp32 default)
     gnorm: jnp.ndarray        # () last global grad norm (L2_grad_norm analog)
 
 
@@ -80,7 +80,8 @@ class _DistributedFusedBase:
 
     def __init__(self, lr, weight_decay=0.0, shard_axis="data",
                  replica_axis: Optional[str] = None, predivide=True,
-                 bf16_allgather=False, check_overflow=True, impl=None):
+                 bf16_allgather=False, check_overflow=True, impl=None,
+                 state_dtype=None):
         if impl is None:
             # measured tuning profile ("zero_impl", written by
             # tools/apply_perf_results.py from the on-chip adam_update /
@@ -98,8 +99,17 @@ class _DistributedFusedBase:
         self.bf16_allgather = bf16_allgather
         self.check_overflow = check_overflow
         self.impl = impl
+        # narrow (e.g. bf16) m/v STORAGE on the sharded flat buffers —
+        # same trade as the single-device flat engine's state_dtype
+        # (optimizers/_base.py): fp32 math, narrow store.  The master
+        # shard p always stays fp32.
+        self.state_dtype = resolve_state_dtype(state_dtype)
         self._fl: Optional[TreeFlattener] = None
         self._fl_key = None
+
+    def _store_moment(self, x):
+        """Cast an fp32-computed moment to its storage dtype (no-op fp32)."""
+        return x.astype(self.state_dtype)
 
     # -- flat packing --------------------------------------------------------
 
@@ -208,8 +218,8 @@ class _DistributedFusedBase:
         # m and v are distinct buffers (donating a shared array twice is an
         # aliasing error on TPU)
         return self._state_cls(jnp.zeros((), jnp.int32), p_shard,
-                               jnp.zeros_like(p_shard),
-                               jnp.zeros_like(p_shard),
+                               jnp.zeros(p_shard.shape, self.state_dtype),
+                               jnp.zeros(p_shard.shape, self.state_dtype),
                                jnp.zeros((), jnp.float32))
 
 
@@ -267,26 +277,31 @@ class DistributedFusedAdam(_DistributedFusedBase):
         eff_scale = inv_scale * clip
         wd = jnp.asarray(self.weight_decay, jnp.float32)
 
+        # moments may be stored narrow (state_dtype): upcast for the fp32
+        # math (the Pallas kernel is fp32-typed), cast back only at store
+        m32 = state.m.astype(jnp.float32)
+        v32 = state.v.astype(jnp.float32)
         if self.impl == "fused":
             scalars = jnp.stack([lr_v, jnp.float32(b1), jnp.float32(b2),
                                  jnp.float32(self.eps), wd, rc1, rc2,
                                  eff_scale]).reshape(1, 8)
             p_new, m_new, v_new = kernels.fused_adam_flat(
-                g_shard, state.p, state.m, state.v, scalars,
+                g_shard, state.p, m32, v32, scalars,
                 adam_w_mode=self.adam_w_mode)
         else:
             g = g_shard * eff_scale
             p = state.p
             if not self.adam_w_mode:
                 g = g + wd * p
-            m_new = b1 * state.m + (1.0 - b1) * g
-            v_new = b2 * state.v + (1.0 - b2) * g * g
+            m_new = b1 * m32 + (1.0 - b1) * g
+            v_new = b2 * v32 + (1.0 - b2) * g * g
             u = (m_new * rc1) / (jnp.sqrt(v_new * rc2) + self.eps)
             if self.adam_w_mode:
                 u = u + wd * p
             p_new = p - lr_v * u
 
-        new_state = ShardedAdamState(count, p_new, m_new, v_new, gnorm)
+        new_state = ShardedAdamState(count, p_new, self._store_moment(m_new),
+                                     self._store_moment(v_new), gnorm)
         new_state = self._select(ok, new_state,
                                  state._replace(gnorm=gnorm))
         full = self._allgather(new_state.p)
@@ -349,22 +364,26 @@ class DistributedFusedLAMB(_DistributedFusedBase):
             rc1 = rc2 = jnp.ones((), jnp.float32)
         wd = jnp.asarray(self.weight_decay, jnp.float32)
 
-        # stage 1 on the shard (same math as the single-device kernel)
+        # stage 1 on the shard (same math as the single-device kernel);
+        # moments may be stored narrow (state_dtype): upcast for the fp32
+        # math, cast back only at store
+        m32 = state.m.astype(jnp.float32)
+        v32 = state.v.astype(jnp.float32)
         if self.impl == "fused":
             scalars = jnp.stack([jnp.float32(b1), jnp.float32(b2),
                                  jnp.float32(self.eps), wd, rc1, rc2, clip,
                                  inv_scale, jnp.asarray(beta3, jnp.float32)
                                  ]).reshape(1, 9)
             u, m_new, v_new = kernels.fused_lamb_stage1_flat(
-                g_shard, state.p, state.m, state.v, scalars,
+                g_shard, state.p, m32, v32, scalars,
                 adam_w_mode=self.adam_w_mode)
         else:
             g = g_shard * inv_scale * clip
             p = state.p
             if not self.adam_w_mode:
                 g = g + wd * p
-            m_new = b1 * state.m + beta3 * g
-            v_new = b2 * state.v + (1.0 - b2) * g * g
+            m_new = b1 * m32 + beta3 * g
+            v_new = b2 * v32 + (1.0 - b2) * g * g
             u = (m_new * rc1) / (jnp.sqrt(v_new * rc2) + self.eps)
             if self.adam_w_mode:
                 u = u + wd * state.p
@@ -391,7 +410,8 @@ class DistributedFusedLAMB(_DistributedFusedBase):
         p_new = (state.p.reshape(u_rows.shape)
                  - lr_v * ratio_rows[:, None] * u_rows).reshape(state.p.shape)
 
-        new_state = ShardedLAMBState(count, p_new, m_new, v_new, gnorm)
+        new_state = ShardedLAMBState(count, p_new, self._store_moment(m_new),
+                                     self._store_moment(v_new), gnorm)
         new_state = self._select(ok, new_state, state._replace(gnorm=gnorm))
         full = self._allgather(new_state.p)
         return fl.unflatten(full), new_state

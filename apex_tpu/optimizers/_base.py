@@ -66,6 +66,19 @@ def resolve(value, count):
     return value
 
 
+def resolve_state_dtype(state_dtype):
+    """Validate + default the moment-storage dtype (shared by the flat
+    engine and the ZeRO optimizers — one guard, no drift)."""
+    if state_dtype is None:
+        return jnp.float32
+    dt = jnp.dtype(state_dtype)
+    if not jnp.issubdtype(dt, jnp.floating):
+        # an int dtype would silently truncate every stored moment
+        # toward zero and stall training with no error
+        raise ValueError(f"state_dtype must be a float dtype, got {dt}")
+    return dt
+
+
 class FusedOptimizer:
     """Base: handles impl selection and the flattener for the fused path.
 
@@ -83,17 +96,10 @@ class FusedOptimizer:
         if state_dtype is not None and impl != "fused":
             raise ValueError("state_dtype is a flat-engine (impl='fused') "
                              "knob; the xla impl keeps fp32 moments")
-        if state_dtype is not None and not jnp.issubdtype(
-                jnp.dtype(state_dtype), jnp.floating):
-            # an int dtype would silently truncate every stored moment
-            # toward zero and stall training with no error
-            raise ValueError(f"state_dtype must be a float dtype, got "
-                             f"{jnp.dtype(state_dtype)}")
         self.lr = lr
         self.weight_decay = weight_decay
         self.impl = impl
-        self.state_dtype = (jnp.float32 if state_dtype is None
-                            else jnp.dtype(state_dtype))
+        self.state_dtype = resolve_state_dtype(state_dtype)
         self._flattener: Optional[TreeFlattener] = None
         self._flattener_key = None
 

@@ -91,6 +91,10 @@ def cost_report(fn: Callable, *args,
     byts = _first(cost, "bytes accessed", "bytes_accessed")
     rep = {
         "platform": platform,
+        # r5 on-chip: the axon backend's compiled cost_analysis can come
+        # back empty/keyless — flag it so a 0-FLOPs report reads as "no
+        # cost data from this backend", not "this program does nothing"
+        "cost_data_available": bool(flops or byts),
         "flops": flops,
         "bytes_accessed": byts,
         "transcendentals": _first(cost, "transcendentals"),

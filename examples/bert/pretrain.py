@@ -65,6 +65,10 @@ def parse_args(argv=None):
                    help="attention impl: 'fast' = the contrib flash "
                         "Pallas kernel (the reference examples' "
                         "fast_self_multihead_attn switch)")
+    p.add_argument("--state-dtype", default=None, choices=[None, "bf16"],
+                   help="store optimizer moments in bf16 (fp32 math; "
+                        "26->18 B/param of step traffic — "
+                        "docs/performance.md)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each layer (recompute activations "
                         "in backward) — O(1)-in-depth activation memory "
@@ -91,7 +95,8 @@ def run_standard(args, cfg, mesh):
     params = jax.jit(
         lambda: init_fn(jax.random.PRNGKey(args.seed), cfg))()
     opt = FusedLAMB(lr=args.lr, weight_decay=0.01, max_grad_norm=1.0,
-                    impl="fused")
+                    impl="fused",
+                    state_dtype=jnp.bfloat16 if args.state_dtype else None)
     state = amp.initialize(params, opt, opt_level=args.opt_level,
                            verbosity=0)
     sharding = NamedSharding(mesh, P("data"))
@@ -129,8 +134,10 @@ def run_zero(args, cfg, mesh):
 
     params = jax.jit(
         lambda: transformer_init(jax.random.PRNGKey(args.seed), cfg))()
-    opt = DistributedFusedLAMB(lr=args.lr, weight_decay=0.01,
-                               max_grad_norm=1.0, bf16_allgather=True)
+    opt = DistributedFusedLAMB(
+        lr=args.lr, weight_decay=0.01, max_grad_norm=1.0,
+        bf16_allgather=True,
+        state_dtype=jnp.bfloat16 if args.state_dtype else None)
     rep = jax.tree_util.tree_map(lambda _: P(), params)
     sspec = opt.state_pspecs()
 

@@ -60,7 +60,7 @@ TRAIN_TO=${APEX_WATCH_TRAIN_TO:-1200}
 INTEROP_CMD=${APEX_WATCH_INTEROP_CMD:-"python tools/bench_interop.py"}
 INTEROP_JSON=${APEX_WATCH_INTEROP_JSON:-INTEROP_r5.json}
 INTEROP_TO=${APEX_WATCH_INTEROP_TO:-600}
-BENCH_TO=${APEX_WATCH_BENCH_TO:-700}
+BENCH_TO=${APEX_WATCH_BENCH_TO:-800}
 KERN_TO=${APEX_WATCH_KERN_TO:-860}
 
 # complete/bench_complete parse the JSON and check TOP-LEVEL fields: a
