@@ -236,3 +236,24 @@ def ensure_live_backend(probe_timeout: float = 75.0) -> str:
         return os.environ.get("JAX_PLATFORMS", "") or "ambient"
     force_cpu()
     return "cpu"
+
+
+def enable_compile_cache(default_dir: str | None = None) -> None:
+    """Turn on jax's persistent compilation cache (best-effort).
+
+    The axon tunnel flaps on minute-scale windows (round 5: two ~1-4 min
+    windows in 27h) and every fresh bench/train process used to re-pay
+    its 20-40s Mosaic/XLA compiles before measuring anything.  Honors
+    ``JAX_COMPILATION_CACHE_DIR``; harmless if the backend ignores it."""
+    if default_dir is None:
+        default_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                         default_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass

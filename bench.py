@@ -639,6 +639,8 @@ def _inner_main(legs_dir=None):
     Raises/hangs are the outer process's problem — that is the point;
     with ``legs_dir`` every completed leg survives on disk regardless."""
     import os
+    from apex_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
     if legs_dir is None and jax.default_backend() == "tpu":
         # TPU runs always flush legs (default dir next to this script):
         # chip time is precious and the tunnel can wedge mid-run — a

@@ -37,6 +37,10 @@
 # (see docs/tpu_tunnel.md; pkill -f "bash tpu_watch").
 cd "${APEX_WATCH_DIR:-/root/repo}"
 
+# persistent XLA compile cache for every stage (benches + train run):
+# minute-scale flap windows must not re-pay 20-40s compiles each time
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/root/repo/.jax_cache}"
+
 LOG=${APEX_WATCH_LOG:-tpu_watch.out}
 SLEEP=${APEX_WATCH_SLEEP:-120}
 N_PROBES=${APEX_WATCH_PROBES:-220}
