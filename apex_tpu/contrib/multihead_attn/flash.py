@@ -295,6 +295,13 @@ def _flash_fwd(q, k, v, bias, causal, dropout_rate, seed, heads,
         scratch_shapes=[pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, D), jnp.float32)],
+        # bh/qi produce independent outputs (parallel); ki accumulates
+        # into scratch sequentially (arbitrary).  Declaring this matters:
+        # the round-3 on-chip measurements (PERF_NOTES §2) put ~10x on
+        # all-arbitrary defaults for grids whose steps Mosaic could
+        # otherwise overlap
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(seed_arr, q, k, v, bias)
     return out[:, :orig_sq], lse[:, :orig_sq]
@@ -442,6 +449,8 @@ def _flash_bwd(q, k, v, bias, causal, dropout_rate, seed, heads, out, lse,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(seed_arr, q, k, v, bias, do, lse, delta)
 
@@ -477,6 +486,8 @@ def _flash_bwd(q, k, v, bias, causal, dropout_rate, seed, heads, out, lse,
                    jax.ShapeDtypeStruct((BH, Sk, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(seed_arr, q, k, v, bias, do, lse, delta)
     return dq[:, :orig_sq], dk[:, :orig_sk], dv[:, :orig_sk]

@@ -117,6 +117,11 @@ def _xent_fwd_pallas(logits, labels, smoothing, bn=256, bh=512):
         out_shape=[jax.ShapeDtypeStruct((n, 1), jnp.float32),
                    jax.ShapeDtypeStruct((n, 1), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((bn, 1), jnp.float32)] * 4,
+        # rows (i) are independent; the vocab walk (j) accumulates into
+        # scratch sequentially.  Same declaration the measured-fast
+        # elementwise kernels carry (PERF_NOTES §2)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(lab, logits)
     return loss[:, 0], lse[:, 0]

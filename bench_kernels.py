@@ -71,14 +71,19 @@ def slope_ms(fn, *args, n1=2, n2=10):
 
 def ab(name, pallas_fn, xla_fn, *args):
     """Time pallas vs xla variants; returns the record (errors recorded,
-    never raised — a kernel that fails Mosaic compile must show up as data)."""
-    rec = {}
+    never raised — a kernel that fails Mosaic compile must show up as data).
+
+    Every field is always present (None = tombstone): a repaired re-run's
+    record deep-merges over the stale leg record, and a missing key would
+    leave the stale value standing next to the new ones (a stale
+    ``speedup`` beside a new failed ``pallas_ms`` — code-review r5)."""
+    rec = {"pallas_ms": None, "pallas_error": None,
+           "xla_ms": None, "xla_error": None, "speedup": None}
     for key, fn in (("pallas_ms", pallas_fn), ("xla_ms", xla_fn)):
         try:
             rec[key] = round(slope_ms(fn, *args), 3)
         except Exception as err:
-            rec[key] = None
-            rec[key[:-3] + "error"] = repr(err)[:200]
+            rec[key[:-3] + "_error"] = repr(err)[:200]
     if rec.get("pallas_ms") and rec.get("xla_ms"):
         rec["speedup"] = round(rec["xla_ms"] / rec["pallas_ms"], 3)
     _log(f"{name}: {rec}")
@@ -142,6 +147,36 @@ def bench_attention(results, on_tpu):
         f"B{B} H{H} S{S} D{D} causal grads(q,k,v)"
 
 
+_PERMANENT_ERR = ("Mosaic", "RESOURCE_EXHAUSTED", "INVALID_ARGUMENT",
+                  "NotImplementedError", "ValueError", "TypeError",
+                  "ImportError", "ModuleNotFoundError", "AttributeError")
+
+
+def _row_settled(v):
+    """A sweep row is settled when it measured (number) or failed for a
+    reason retrying cannot change (compile/shape/import errors).  A
+    transient failure — the tunnel collapsing mid-sweep raises from
+    whatever call was in flight — must NOT count as settled, or the
+    resume logic freezes the section "complete" with garbage rows in
+    exactly the flaky-window scenario it was built for (code-review r5)."""
+    if isinstance(v, (int, float)):
+        return True
+    return isinstance(v, str) and any(m in v for m in _PERMANENT_ERR)
+
+
+def _ab_settled(rec):
+    """Settledness of an :func:`ab` record: each side either measured or
+    permanently failed."""
+    if not isinstance(rec, dict) or "pallas_ms" not in rec:
+        return True                    # not an ab record: presence is enough
+    return all(isinstance(rec.get(f"{side}_ms"), (int, float))
+               or _row_settled(rec.get(f"{side}_error"))
+               for side in ("pallas", "xla"))
+
+
+ATTN_SWEEP_LABEL = "B8 H16 D64 fwd+bwd grads(q,k,v)"
+
+
 def bench_flash_bwd_autotune(results, on_tpu, flush=lambda *a: None):
     """Directly sweep the recompute-backward kernels' block sizes.
 
@@ -163,22 +198,32 @@ def bench_flash_bwd_autotune(results, on_tpu, flush=lambda *a: None):
     k = jax.random.normal(key, (B * H, S, D), jnp.bfloat16)
     v = jax.random.normal(key, (B * H, S, D), jnp.bfloat16)
     bias = jnp.zeros((1, 1, S), jnp.float32)
-    out, lse = jax.jit(functools.partial(
-        _flash_fwd, causal=True, dropout_rate=0.0, seed=0, heads=H))(
-            q, k, v, bias)
-    do = jax.random.normal(jax.random.PRNGKey(1), out.shape, out.dtype)
 
-    prior = dict((results.get("flash_bwd_autotune") or {})
+    res = {}
+
+    def residuals():
+        # lazy: a resume window that only needs the jax_ref row must not
+        # pay the fwd compile+run for residuals nothing consumes
+        if not res:
+            out, lse = jax.jit(functools.partial(
+                _flash_fwd, causal=True, dropout_rate=0.0, seed=0,
+                heads=H))(q, k, v, bias)
+            res["out"], res["lse"] = out, lse
+            res["do"] = jax.random.normal(jax.random.PRNGKey(1), out.shape,
+                                          out.dtype)
+        return res["out"], res["lse"], res["do"]
+
+    sweep = dict((results.get("flash_bwd_autotune") or {})
                  .get("sweep_ms") or {})
-    sweep = prior
     for bq, bk in ((128, 128), (128, 256), (256, 256), (256, 512),
                    (512, 512), (512, 1024), (1024, 1024)):
         cfg = f"{bq}x{bk}"
-        if cfg in sweep:
+        if _row_settled(sweep.get(cfg)):
             continue
         fn = jax.jit(functools.partial(
             _flash_bwd, causal=True, dropout_rate=0.0, seed=0, heads=H,
             bq=bq, bk=bk))
+        out, lse, do = residuals()
         try:
             sweep[cfg] = round(slope_ms(
                 lambda q, k, v: fn(q, k, v, bias, out=out, lse=lse, do=do),
@@ -198,7 +243,7 @@ def bench_flash_bwd_autotune(results, on_tpu, flush=lambda *a: None):
               {"flash_bwd_autotune": results["flash_bwd_autotune"]},
               merge=True)
 
-    if "jax_ref_fwdbwd" not in sweep:
+    if not _row_settled(sweep.get("jax_ref_fwdbwd")):
         try:  # env-sanity: jax's own pallas flash kernel, full fwd+bwd
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention as jax_flash)
@@ -235,10 +280,21 @@ def bench_attn_seq_sweep(results, on_tpu, flush=lambda *a: None):
     from apex_tpu.contrib.multihead_attn.functional import attention_core
 
     B, H, D = 8, 16, 64
-    sweep = dict((results.get("attn_seq_sweep") or {}).get("by_seq") or {})
+    prior_rec = results.get("attn_seq_sweep") or {}
+    # semantics fingerprint: rows measured by an older revision (dq-only
+    # grads) must not mix with grads(q,k,v) rows under one label
+    if prior_rec.get("by_seq") and prior_rec.get("shape") != ATTN_SWEEP_LABEL:
+        # reset the leg too: later merge=True flushes would deep-merge the
+        # stale-semantics rows right back into by_seq
+        results["attn_seq_sweep"] = {"shape": ATTN_SWEEP_LABEL, "by_seq": {}}
+        flush("attn_seq_sweep", {"attn_seq_sweep": results["attn_seq_sweep"]},
+              merge=False)
+        prior_rec = results["attn_seq_sweep"]
+    sweep = (dict(prior_rec.get("by_seq") or {})
+             if prior_rec.get("shape") == ATTN_SWEEP_LABEL else {})
     for S in (64, 128, 256, 512, 1024, 2048):
-        if str(S) in sweep:        # captured by a previous flap window
-            continue
+        if _ab_settled(sweep.get(str(S))) and str(S) in sweep:
+            continue               # captured by a previous flap window
         key = jax.random.PRNGKey(S)
         scale = 1.0 / np.sqrt(D)
         q = jax.random.normal(key, (B * H, S, D), jnp.bfloat16) * scale
@@ -259,9 +315,8 @@ def bench_attn_seq_sweep(results, on_tpu, flush=lambda *a: None):
 
         sweep[str(S)] = ab(f"attn_seq_{S}", jax.jit(fast_fb),
                            jax.jit(default_fb), q, k, v)
-        results["attn_seq_sweep"] = {
-            "shape": f"B{B} H{H} D{D} fwd+bwd grads(q,k,v)",
-            "by_seq": dict(sweep)}
+        results["attn_seq_sweep"] = {"shape": ATTN_SWEEP_LABEL,
+                                     "by_seq": dict(sweep)}
         # flush after every seq length: a mid-sweep wedge keeps the
         # completed rows (round-4 verdict item 2).  Wrapped under the
         # result key so assemble() merges section and intra-leg flushes
@@ -290,8 +345,8 @@ def bench_flash_autotune(results, on_tpu, flush=lambda *a: None):
     sweep = dict((results.get("flash_autotune") or {}).get("sweep_ms") or {})
     for bq, bk in ((128, 512), (256, 512), (256, 1024), (512, 512),
                    (512, 1024)):
-        if f"{bq}x{bk}" in sweep:  # captured by a previous flap window
-            continue
+        if _row_settled(sweep.get(f"{bq}x{bk}")):
+            continue               # captured by a previous flap window
         fn = jax.jit(functools.partial(
             _flash_fwd, causal=True, dropout_rate=0.0, seed=0, heads=H,
             bq=bq, bk=bk))
@@ -566,26 +621,40 @@ def run(budget_left=lambda: 1e9, legs_dir=None):
         done_keys.update(results.keys())
 
     def _complete(keys, sweep_done=None):
-        if not all(k in results for k in keys):
+        # ab-record keys must be SETTLED, not merely present: a transient
+        # mid-sweep failure (tunnel collapse) may be recorded as an error
+        # row, and freezing it as "complete" would defeat resume in the
+        # flaky-window scenario it exists for (code-review r5)
+        if not all(k in results and _ab_settled(results[k]) for k in keys):
             return False
         if sweep_done is not None and not sweep_done():
             return False
         return True
 
+    def _sweep_settled(key, field, want):
+        rows = (results[key].get(field) or {})
+        if key == "attn_seq_sweep" \
+                and results[key].get("shape") != ATTN_SWEEP_LABEL:
+            return False           # rows from an older measurement revision
+        settled = [v for v in rows.values()
+                   if (_row_settled(v) if not isinstance(v, dict)
+                       else _ab_settled(v))]
+        return len(settled) >= want
+
     sections = (
         (bench_attention, ("flash_attn_fwd", "flash_attn_fwdbwd",
                            "flash_attn_fwdbwd_qkv"), None),
         (bench_xentropy, ("xentropy_fwd", "xentropy_fwdbwd"), None),
-        (bench_flash_bwd_autotune, ("flash_bwd_autotune",), lambda: len(
-            (results["flash_bwd_autotune"].get("sweep_ms") or {})) >= 8),
+        (bench_flash_bwd_autotune, ("flash_bwd_autotune",),
+         lambda: _sweep_settled("flash_bwd_autotune", "sweep_ms", 8)),
         (bench_layer_norm, ("layer_norm_fwd", "layer_norm_fwdbwd"), None),
         (bench_mlp, ("mlp_fwd", "mlp_fwdbwd"), None),
         (bench_multi_tensor, ("l2norm", "scale_flagged", "axpby_flagged",
                               "adam_update", "lamb_stage1"), None),
-        (bench_flash_autotune, ("flash_autotune",), lambda: len(
-            (results["flash_autotune"].get("sweep_ms") or {})) >= 5),
-        (bench_attn_seq_sweep, ("attn_seq_sweep",), lambda: len(
-            (results["attn_seq_sweep"].get("by_seq") or {})) >= 6),
+        (bench_flash_autotune, ("flash_autotune",),
+         lambda: _sweep_settled("flash_autotune", "sweep_ms", 5)),
+        (bench_attn_seq_sweep, ("attn_seq_sweep",),
+         lambda: _sweep_settled("attn_seq_sweep", "by_seq", 6)),
         (bench_flash_vmem_probe, ("flash_vmem_probe",), None),
     )
     for fn, keys, sweep_done in sections:

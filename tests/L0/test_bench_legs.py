@@ -331,24 +331,34 @@ def _load_kernels():
     return mod
 
 
+def _ab_rec(p, x):
+    return {"pallas_ms": p, "xla_ms": x}
+
+
+_SEQ_LABEL = "B8 H16 D64 fwd+bwd grads(q,k,v)"   # bench_kernels.ATTN_SWEEP_LABEL
+
 _COMPLETE_LEGS = {
-    "attention": {"flash_attn_fwd": {"pallas_ms": 1.0},
-                  "flash_attn_fwdbwd": {"pallas_ms": 2.0},
-                  "flash_attn_fwdbwd_qkv": {"pallas_ms": 3.0}},
-    "xentropy": {"xentropy_fwd": {"pallas_ms": 1.4},
-                 "xentropy_fwdbwd": {"pallas_ms": 2.8}},
+    "attention": {"flash_attn_fwd": _ab_rec(1.0, 1.5),
+                  "flash_attn_fwdbwd": _ab_rec(2.0, 2.5),
+                  "flash_attn_fwdbwd_qkv": _ab_rec(3.0, 3.5)},
+    "xentropy": {"xentropy_fwd": _ab_rec(1.4, 2.7),
+                 "xentropy_fwdbwd": _ab_rec(2.8, 5.4)},
     "flash_bwd_autotune": {"flash_bwd_autotune": {
         "sweep_ms": {f"{b}x{b}": 1.0 for b in range(8)}, "best": "0x0"}},
-    "layer_norm": {"layer_norm_fwd": {}, "layer_norm_fwdbwd": {}},
-    "mlp": {"mlp_fwd": {}, "mlp_fwdbwd": {}},
-    "multi_tensor": {"l2norm": {}, "scale_flagged": {},
-                     "axpby_flagged": {}, "adam_update": {},
-                     "lamb_stage1": {}},
+    "layer_norm": {"layer_norm_fwd": _ab_rec(1.0, 1.0),
+                   "layer_norm_fwdbwd": _ab_rec(1.0, 1.0)},
+    "mlp": {"mlp_fwd": _ab_rec(1.0, 1.0), "mlp_fwdbwd": _ab_rec(1.0, 1.0)},
+    "multi_tensor": {"l2norm": _ab_rec(1.0, 1.0),
+                     "scale_flagged": _ab_rec(1.0, 1.0),
+                     "axpby_flagged": _ab_rec(1.0, 1.0),
+                     "adam_update": _ab_rec(1.0, 1.0),
+                     "lamb_stage1": _ab_rec(1.0, 1.0)},
     "flash_autotune": {"flash_autotune": {"sweep_ms": {
         c: 1.0 for c in ("128x512", "256x512", "256x1024", "512x512",
                          "512x1024")}, "best": "128x512"}},
-    "attn_seq_sweep": {"attn_seq_sweep": {"by_seq": {
-        str(s): {} for s in (64, 128, 256, 512, 1024, 2048)}}},
+    "attn_seq_sweep": {"attn_seq_sweep": {"shape": _SEQ_LABEL, "by_seq": {
+        str(s): _ab_rec(1.0, 1.0)
+        for s in (64, 128, 256, 512, 1024, 2048)}}},
     "flash_vmem_probe": {"flash_vmem_probe": {"rows": []}},
 }
 
@@ -376,7 +386,7 @@ def test_kernel_bench_resume_skips_complete_sections(tmp_path, monkeypatch):
     _patch_sections(bk, monkeypatch, calls)
     out = bk.run(legs_dir=d)
     assert calls == []                       # every section skipped
-    assert out["kernels"]["xentropy_fwd"] == {"pallas_ms": 1.4}
+    assert out["kernels"]["xentropy_fwd"] == _ab_rec(1.4, 2.7)
     assert out["backend"] == "tpu"
 
 
@@ -387,8 +397,10 @@ def test_kernel_bench_resume_reruns_incomplete_sweep(tmp_path, monkeypatch):
     legs = dict(_COMPLETE_LEGS)
     # seq sweep captured only 3 of 6 rows; attention leg predates the
     # fwdbwd_qkv key (the r5 first capture's exact shape)
-    legs["attn_seq_sweep"] = {"attn_seq_sweep": {"by_seq": {
-        "64": {}, "128": {}, "256": {}}}}
+    legs["attn_seq_sweep"] = {"attn_seq_sweep": {
+        "shape": _SEQ_LABEL,
+        "by_seq": {"64": _ab_rec(1.0, 1.0), "128": _ab_rec(1.0, 1.0),
+                   "256": _ab_rec(1.0, 1.0)}}}
     legs["attention"] = {"flash_attn_fwd": {"pallas_ms": 0.0},
                          "flash_attn_fwdbwd": {"pallas_ms": 192.9}}
     for leg, data in legs.items():
@@ -421,3 +433,50 @@ def test_kernel_bench_cpu_run_ignores_tpu_legs(tmp_path, monkeypatch):
     out = bk.run(legs_dir=d)                 # ambient backend = cpu
     assert len(calls) == len(_SECTION_FNS)   # nothing skipped
     assert "xentropy_fwd" not in out["kernels"]
+
+
+def test_kernel_bench_transient_failure_rows_do_not_settle(tmp_path,
+                                                           monkeypatch):
+    """A mid-sweep tunnel collapse recorded as an error row must re-run on
+    the next window; a permanent (Mosaic/compile) failure must not."""
+    bk = _load_kernels()
+    monkeypatch.setattr(bk.jax, "default_backend", lambda: "tpu")
+    d = str(tmp_path / "legs")
+    legs = dict(_COMPLETE_LEGS)
+    sweep = {f"{b}x{b}": 1.0 for b in range(7)}
+    sweep["7x7"] = "failed: XlaRuntimeError('INTERNAL: stream closed')"
+    legs["flash_bwd_autotune"] = {"flash_bwd_autotune": {
+        "sweep_ms": sweep, "best": "0x0"}}
+    for leg, data in legs.items():
+        flush_leg(d, leg, data, backend="tpu")
+    calls = []
+    _patch_sections(bk, monkeypatch, calls)
+    bk.run(legs_dir=d)
+    assert calls == ["bench_flash_bwd_autotune"]    # transient -> retry
+
+    # flip the row to a permanent Mosaic failure: now settled, no re-run
+    sweep["7x7"] = "failed: Mosaic lowering: RESOURCE_EXHAUSTED vmem"
+    flush_leg(d, "flash_bwd_autotune", {"flash_bwd_autotune": {
+        "sweep_ms": sweep, "best": "0x0"}}, backend="tpu")
+    calls.clear()
+    bk.run(legs_dir=d)
+    assert calls == []
+
+
+def test_kernel_bench_seq_sweep_stale_semantics_reset(tmp_path, monkeypatch):
+    """by_seq rows measured by an older revision (different shape label)
+    must not satisfy completeness nor leak into the new sweep."""
+    bk = _load_kernels()
+    monkeypatch.setattr(bk.jax, "default_backend", lambda: "tpu")
+    d = str(tmp_path / "legs")
+    legs = dict(_COMPLETE_LEGS)
+    legs["attn_seq_sweep"] = {"attn_seq_sweep": {
+        "shape": "B8 H16 D64 fwd+bwd(dq)",          # the r4 measurement
+        "by_seq": {str(s): _ab_rec(1.0, 1.0)
+                   for s in (64, 128, 256, 512, 1024, 2048)}}}
+    for leg, data in legs.items():
+        flush_leg(d, leg, data, backend="tpu")
+    calls = []
+    _patch_sections(bk, monkeypatch, calls)
+    bk.run(legs_dir=d)
+    assert calls == ["bench_attn_seq_sweep"]

@@ -39,7 +39,7 @@ cd "${APEX_WATCH_DIR:-/root/repo}"
 
 # persistent XLA compile cache for every stage (benches + train run):
 # minute-scale flap windows must not re-pay 20-40s compiles each time
-export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/root/repo/.jax_cache}"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${APEX_WATCH_DIR:-/root/repo}/.jax_cache}"
 
 LOG=${APEX_WATCH_LOG:-tpu_watch.out}
 SLEEP=${APEX_WATCH_SLEEP:-120}
