@@ -55,24 +55,48 @@ def _clamp_blocks(bq, bk, D, esz, bias_per_q, bwd=False, sq=None, sk=None):
     accumulators).  Alignment floors: bk multiple of 128 (lane dim of the
     bias block), bq multiple of 8 (sublane)."""
     import os
-    # pinned = explicitly chosen, by argument OR by env (docs tell users to
-    # pin the autotune winner via env; a pin that got silently re-clamped
-    # would run a different kernel than the one measured)
-    bq_pinned = bq is not None or "APEX_TPU_FLASH_BLOCK_Q" in os.environ
-    bk_pinned = bk is not None or "APEX_TPU_FLASH_BLOCK_K" in os.environ
-    # precedence: argument > env pin > measured tuning profile > built-in.
-    # Tuned values are NOT pins: the autotune sweeps one shape, and the
-    # VMEM clamp below must still protect other shapes from a config
-    # that only fit where it was measured.
+    # the backward kernels have their own optimum (the r5 on-chip sweep
+    # measures them separately — fwd blocks that stream k/v differ from
+    # bwd blocks that also stream do and accumulate dk/dv), so bwd=True
+    # consults the BWD env pins / tuning keys first and falls back to the
+    # shared fwd chain
+    env_q = ["APEX_TPU_FLASH_BLOCK_Q"]
+    env_k = ["APEX_TPU_FLASH_BLOCK_K"]
+    tune_q = ["flash_block_q"]
+    tune_k = ["flash_block_k"]
+    if bwd:
+        env_q.insert(0, "APEX_TPU_FLASH_BWD_BLOCK_Q")
+        env_k.insert(0, "APEX_TPU_FLASH_BWD_BLOCK_K")
+        tune_q.insert(0, "flash_bwd_block_q")
+        tune_k.insert(0, "flash_bwd_block_k")
+    # pinned = explicitly chosen, by argument OR by the env var the value
+    # actually came from (docs tell users to pin the autotune winner via
+    # env; a pin that got silently re-clamped would run a different
+    # kernel than the one measured).  Values sourced from the tuning
+    # PROFILE are not pins: the autotune sweeps one shape, and the VMEM
+    # clamp below must still protect other shapes from a config that
+    # only fit where it was measured.
+    # precedence: argument > [bwd env > bwd profile] > [env > profile]
+    # > built-in — each tier exhausted before the next, so a fwd env pin
+    # can never shadow the measured bwd profile (the bwd optimum is the
+    # whole point of the split).
     from ...utils import tuning
+
+    def _pick(envs, tunes, default):
+        for e, t in zip(envs, tunes):
+            if e in os.environ:
+                return int(os.environ[e]), True
+            v = tuning.get_on_tpu(t, None)
+            if v is not None:
+                return int(v), False
+        return default, False
+
+    bq_pinned = bq is not None
+    bk_pinned = bk is not None
     if bq is None:
-        bq = int(os.environ.get("APEX_TPU_FLASH_BLOCK_Q",
-                                tuning.get_on_tpu("flash_block_q",
-                                                  DEFAULT_BLOCK_Q)))
+        bq, bq_pinned = _pick(env_q, tune_q, DEFAULT_BLOCK_Q)
     if bk is None:
-        bk = int(os.environ.get("APEX_TPU_FLASH_BLOCK_K",
-                                tuning.get_on_tpu("flash_block_k",
-                                                  DEFAULT_BLOCK_K)))
+        bk, bk_pinned = _pick(env_k, tune_k, DEFAULT_BLOCK_K)
     if sq is not None:
         bq = min(bq, max(8, -(-sq // 8) * 8))
     if sk is not None:

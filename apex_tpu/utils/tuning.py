@@ -5,7 +5,9 @@ The round-5 close of the perf loop: on-chip benchmark results
 ``tools/apply_perf_results.py`` into one JSON profile of measured
 winners, and every tunable default consults it at trace time:
 
-  - flash-attention block sizes (``flash_block_q`` / ``flash_block_k``)
+  - flash-attention block sizes (``flash_block_q`` / ``flash_block_k``;
+    the recompute-backward kernels' own winners ``flash_bwd_block_q`` /
+    ``flash_bwd_block_k``, falling back to the fwd keys)
   - the xentropy ``impl="auto"`` resolution (``xent_auto_impl``)
   - the flagship BERT config's attention path (``bert_attn_impl``)
   - layer-norm / MLP Pallas-vs-XLA choice (``layer_norm_use_pallas``,

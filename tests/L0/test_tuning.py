@@ -93,6 +93,51 @@ def test_flash_clamp_consults_profile(profile, fake_tpu):
     # explicit arguments always win over the profile
     bq, bk = _clamp_blocks(64, 128, D=64, esz=2, bias_per_q=False)
     assert (bq, bk) == (64, 128)
+    # without bwd-specific keys, bwd falls back to the shared fwd keys
+    bq, bk = _clamp_blocks(None, None, D=64, esz=2, bias_per_q=False,
+                           bwd=True)
+    assert (bq, bk) == (128, 256)
+
+
+def test_flash_clamp_bwd_keys_override_fwd(profile, fake_tpu):
+    """The recompute-backward kernels have their own measured optimum:
+    flash_bwd_block_q/k beat the shared keys for bwd=True only."""
+    from apex_tpu.contrib.multihead_attn.flash import _clamp_blocks
+    profile({"flash_block_q": 512, "flash_block_k": 1024,
+             "flash_bwd_block_q": 128, "flash_bwd_block_k": 256})
+    assert _clamp_blocks(None, None, D=64, esz=2,
+                         bias_per_q=False) == (512, 1024)
+    assert _clamp_blocks(None, None, D=64, esz=2, bias_per_q=False,
+                         bwd=True) == (128, 256)
+
+
+def test_flash_clamp_fwd_env_pin_does_not_shadow_bwd_profile(
+        profile, fake_tpu, monkeypatch):
+    """A user who pinned the fwd autotune winner via env must still get
+    the measured bwd profile for bwd=True: precedence is tiered
+    [bwd env, bwd profile] before [fwd env, fwd profile] (code-review
+    r5 — the flat order re-created the fwd-blocks-on-bwd pathology)."""
+    from apex_tpu.contrib.multihead_attn.flash import _clamp_blocks
+    monkeypatch.setenv("APEX_TPU_FLASH_BLOCK_Q", "512")
+    monkeypatch.setenv("APEX_TPU_FLASH_BLOCK_K", "1024")
+    profile({"flash_bwd_block_q": 128, "flash_bwd_block_k": 256})
+    assert _clamp_blocks(None, None, D=64, esz=2, bias_per_q=False,
+                         bwd=True) == (128, 256)
+    assert _clamp_blocks(None, None, D=64, esz=2,
+                         bias_per_q=False) == (512, 1024)
+
+
+def test_flash_clamp_bwd_env_pin(profile, fake_tpu, monkeypatch):
+    """APEX_TPU_FLASH_BWD_BLOCK_Q/_K pin the bwd blocks (and count as
+    pinned — no budget rewrite), while the fwd path ignores them."""
+    from apex_tpu.contrib.multihead_attn.flash import _clamp_blocks
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_BLOCK_Q", "256")
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_BLOCK_K", "512")
+    monkeypatch.setenv("APEX_TPU_FLASH_VMEM_MB", "0.25")  # would shrink
+    assert _clamp_blocks(None, None, D=64, esz=2, bias_per_q=False,
+                         bwd=True) == (256, 512)
+    fwd = _clamp_blocks(None, None, D=64, esz=2, bias_per_q=False)
+    assert fwd != (256, 512)                   # fwd unaffected by bwd pins
 
 
 def test_layer_norm_auto_uses_profile(profile, fake_tpu, monkeypatch):
@@ -182,6 +227,8 @@ def _tpu_artifacts():
             "kernels": {
                 "flash_autotune": {"best": "256x1024",
                                    "sweep_ms": {"256x1024": 1.2}},
+                "flash_bwd_autotune": {"best": "128x256",
+                                       "sweep_ms": {"128x256": 3.0}},
                 "xentropy_fwdbwd": {"speedup": 1.3},
                 "layer_norm_fwdbwd": {"speedup": 0.8},
                 "mlp_fwdbwd": {"speedup": 1.1},
@@ -199,6 +246,8 @@ def test_decide_applies_rules():
     bench, kern = _tpu_artifacts()
     prof, rows = mod.decide(bench, kern)
     assert prof["flash_block_q"] == 256 and prof["flash_block_k"] == 1024
+    assert prof["flash_bwd_block_q"] == 128
+    assert prof["flash_bwd_block_k"] == 256
     assert prof["xent_auto_impl"] == "pallas"
     assert prof["layer_norm_use_pallas"] is False
     assert prof["mlp_use_pallas"] is True

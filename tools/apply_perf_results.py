@@ -11,7 +11,10 @@ measured-tuning profile every tunable default consults
 Decision rules (each key is only written when its evidence is present
 and TPU-backed; absent keys leave the built-in defaults untouched):
 
-  flash_block_q/k       <- flash_autotune.best (the swept winner)
+  flash_block_q/k       <- flash_autotune.best (the swept fwd winner)
+  flash_bwd_block_q/k   <- flash_bwd_autotune.best (the bwd kernels'
+                           own winner; _clamp_blocks consults it for
+                           bwd=True with fallback to the fwd keys)
   xent_auto_impl        <- xentropy_fwdbwd speedup (pallas vs xla)
   bert_attn_impl        <- attn_seq_sweep: mean fast-vs-default speedup
                            at seq >= 512 (the flagship's regime)
@@ -76,6 +79,15 @@ def decide(bench, kern):
             prof["flash_block_k"] = bk
             rows.append(("flash blocks", f"{bq}x{bk}",
                          f"autotune sweep {at.get('sweep_ms')}"))
+
+        bt = _tpu_kernel(kernels, "flash_bwd_autotune")
+        best = bt.get("best") if bt else None
+        if isinstance(best, str) and best.count("x") == 1:
+            bq, bk = (int(v) for v in best.split("x"))
+            prof["flash_bwd_block_q"] = bq
+            prof["flash_bwd_block_k"] = bk
+            rows.append(("flash bwd blocks", f"{bq}x{bk}",
+                         f"bwd sweep {bt.get('sweep_ms')}"))
 
         xe = _tpu_kernel(kernels, "xentropy_fwdbwd") or _tpu_kernel(
             kernels, "xentropy_fwd")
