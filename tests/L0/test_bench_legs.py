@@ -354,8 +354,9 @@ _COMPLETE_LEGS = {
                      "adam_update": _ab_rec(1.0, 1.0),
                      "lamb_stage1": _ab_rec(1.0, 1.0)},
     "flash_autotune": {"flash_autotune": {"sweep_ms": {
-        c: 1.0 for c in ("128x512", "256x512", "256x1024", "512x512",
-                         "512x1024")}, "best": "128x512"}},
+        c: 1.0 for c in ("128x128", "128x256", "128x512", "256x512",
+                         "256x1024", "512x512", "512x1024")},
+        "best": "128x512"}},
     "attn_seq_sweep": {"attn_seq_sweep": {"shape": _SEQ_LABEL, "by_seq": {
         str(s): _ab_rec(1.0, 1.0)
         for s in (64, 128, 256, 512, 1024, 2048)}}},
