@@ -359,7 +359,7 @@ _COMPLETE_LEGS = {
         "best": "128x512"}},
     "attn_seq_sweep": {"attn_seq_sweep": {"shape": _SEQ_LABEL, "by_seq": {
         str(s): _ab_rec(1.0, 1.0)
-        for s in (64, 128, 256, 512, 1024, 2048)}}},
+        for s in (64, 128, 256, 512, 1024, 2048, 4096)}}},
     "flash_vmem_probe": {"flash_vmem_probe": {"rows": []}},
 }
 
