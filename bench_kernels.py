@@ -175,6 +175,7 @@ def _ab_settled(rec):
 
 
 ATTN_SWEEP_LABEL = "B8 H16 D64 fwd+bwd grads(q,k,v)"
+ATTN_SWEEP_SEQS = (64, 128, 256, 512, 1024, 2048, 4096)
 
 
 def bench_flash_bwd_autotune(results, on_tpu, flush=lambda *a: None):
@@ -296,7 +297,7 @@ def bench_attn_seq_sweep(results, on_tpu, flush=lambda *a: None):
     # (B,H,S,S) scores (8.6 GB at f32 before bwd temporaries) while the
     # flash path stays O(S) — an expected xla-side RESOURCE_EXHAUSTED
     # there is the capability datum, not a failure
-    for S in (64, 128, 256, 512, 1024, 2048, 4096):
+    for S in ATTN_SWEEP_SEQS:
         if _ab_settled(sweep.get(str(S))) and str(S) in sweep:
             continue               # captured by a previous flap window
         key = jax.random.PRNGKey(S)
@@ -667,7 +668,8 @@ def run(budget_left=lambda: 1e9, legs_dir=None):
         (bench_flash_autotune, ("flash_autotune",),
          lambda: _sweep_settled("flash_autotune", "sweep_ms", 7)),
         (bench_attn_seq_sweep, ("attn_seq_sweep",),
-         lambda: _sweep_settled("attn_seq_sweep", "by_seq", 7)),
+         lambda: _sweep_settled("attn_seq_sweep", "by_seq",
+                                len(ATTN_SWEEP_SEQS))),
         (bench_flash_vmem_probe, ("flash_vmem_probe",), None),
     )
     for fn, keys, sweep_done in sections:

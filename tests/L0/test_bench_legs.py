@@ -357,9 +357,9 @@ _COMPLETE_LEGS = {
         c: 1.0 for c in ("128x128", "128x256", "128x512", "256x512",
                          "256x1024", "512x512", "512x1024")},
         "best": "128x512"}},
-    "attn_seq_sweep": {"attn_seq_sweep": {"shape": _SEQ_LABEL, "by_seq": {
-        str(s): _ab_rec(1.0, 1.0)
-        for s in (64, 128, 256, 512, 1024, 2048, 4096)}}},
+    # attn_seq_sweep is injected per-test from the loaded module's own
+    # ATTN_SWEEP_SEQS/ATTN_SWEEP_LABEL (drift guard: the bench loop, the
+    # completeness want, and this fixture share one constant)
     "flash_vmem_probe": {"flash_vmem_probe": {"rows": []}},
 }
 
@@ -367,6 +367,16 @@ _SECTION_FNS = ("bench_attention", "bench_xentropy",
                 "bench_flash_bwd_autotune", "bench_layer_norm", "bench_mlp",
                 "bench_multi_tensor", "bench_flash_autotune",
                 "bench_attn_seq_sweep", "bench_flash_vmem_probe")
+
+
+def _complete_legs(bk):
+    legs = dict(_COMPLETE_LEGS)
+    assert bk.ATTN_SWEEP_LABEL == _SEQ_LABEL
+    legs["attn_seq_sweep"] = {"attn_seq_sweep": {
+        "shape": bk.ATTN_SWEEP_LABEL,
+        "by_seq": {str(s): _ab_rec(1.0, 1.0)
+                   for s in bk.ATTN_SWEEP_SEQS}}}
+    return legs
 
 
 def _patch_sections(bk, monkeypatch, calls):
@@ -381,7 +391,7 @@ def test_kernel_bench_resume_skips_complete_sections(tmp_path, monkeypatch):
     bk = _load_kernels()
     monkeypatch.setattr(bk.jax, "default_backend", lambda: "tpu")
     d = str(tmp_path / "legs")
-    for leg, data in _COMPLETE_LEGS.items():
+    for leg, data in _complete_legs(bk).items():
         flush_leg(d, leg, data, backend="tpu")
     calls = []
     _patch_sections(bk, monkeypatch, calls)
@@ -395,7 +405,7 @@ def test_kernel_bench_resume_reruns_incomplete_sweep(tmp_path, monkeypatch):
     bk = _load_kernels()
     monkeypatch.setattr(bk.jax, "default_backend", lambda: "tpu")
     d = str(tmp_path / "legs")
-    legs = dict(_COMPLETE_LEGS)
+    legs = _complete_legs(bk)
     # seq sweep captured only 3 of 6 rows; attention leg predates the
     # fwdbwd_qkv key (the r5 first capture's exact shape)
     legs["attn_seq_sweep"] = {"attn_seq_sweep": {
@@ -427,7 +437,7 @@ def test_kernel_bench_cpu_run_ignores_tpu_legs(tmp_path, monkeypatch):
     """A CPU fallback must not seed TPU numbers into its own payload."""
     bk = _load_kernels()
     d = str(tmp_path / "legs")
-    for leg, data in _COMPLETE_LEGS.items():
+    for leg, data in _complete_legs(bk).items():
         flush_leg(d, leg, data, backend="tpu")
     calls = []
     _patch_sections(bk, monkeypatch, calls)
@@ -443,7 +453,7 @@ def test_kernel_bench_transient_failure_rows_do_not_settle(tmp_path,
     bk = _load_kernels()
     monkeypatch.setattr(bk.jax, "default_backend", lambda: "tpu")
     d = str(tmp_path / "legs")
-    legs = dict(_COMPLETE_LEGS)
+    legs = _complete_legs(bk)
     sweep = {f"{b}x{b}": 1.0 for b in range(7)}
     sweep["7x7"] = "failed: XlaRuntimeError('INTERNAL: stream closed')"
     legs["flash_bwd_autotune"] = {"flash_bwd_autotune": {
@@ -470,7 +480,7 @@ def test_kernel_bench_seq_sweep_stale_semantics_reset(tmp_path, monkeypatch):
     bk = _load_kernels()
     monkeypatch.setattr(bk.jax, "default_backend", lambda: "tpu")
     d = str(tmp_path / "legs")
-    legs = dict(_COMPLETE_LEGS)
+    legs = _complete_legs(bk)
     legs["attn_seq_sweep"] = {"attn_seq_sweep": {
         "shape": "B8 H16 D64 fwd+bwd(dq)",          # the r4 measurement
         "by_seq": {str(s): _ab_rec(1.0, 1.0)
