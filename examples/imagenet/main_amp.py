@@ -147,12 +147,12 @@ def synthetic_batches(batch, seed, steps):
     THROUGHPUT vehicle, not a learnability proof; train on real/memmap
     data (``--data``) when using the native loader for numerics."""
     protos = _syn_protos()
-    rng = np.random.RandomState(seed)
+    rng = np.random.Generator(np.random.PCG64(seed))
     for _ in range(steps):
-        labels = rng.randint(0, _SYN_CLASSES, size=(batch,))
-        images = (protos[labels]
-                  + rng.normal(0.0, 0.08, (batch, 224, 224, 3))
-                  .astype(np.float32))
+        labels = rng.integers(0, _SYN_CLASSES, size=(batch,))
+        # native f32 draw: no double-sized f64 temporary on the feed path
+        images = protos[labels] + 0.08 * rng.standard_normal(
+            (batch, 224, 224, 3), dtype=np.float32)
         yield images, labels.astype(np.int32)
 
 
