@@ -1,5 +1,7 @@
 #!/bin/bash
-# Round-5 tunnel watcher.  Probe the axon tunnel every 2 min; on recovery
+# Round-5 tunnel watcher.  Probe the axon tunnel every ~100s (50s
+# hung-probe timeout + 45s sleep — a 1-minute flap window must not fall
+# between probes); on recovery
 # run the capture stages in INFORMATION-VALUE order with INCREMENTAL
 # per-leg flushing (--legs-dir), so a tunnel that re-wedges mid-run still
 # leaves every completed leg on disk (round-4 verdict item 2).
@@ -42,14 +44,14 @@ cd "${APEX_WATCH_DIR:-/root/repo}"
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${APEX_WATCH_DIR:-/root/repo}/.jax_cache}"
 
 LOG=${APEX_WATCH_LOG:-tpu_watch.out}
-SLEEP=${APEX_WATCH_SLEEP:-120}
-N_PROBES=${APEX_WATCH_PROBES:-220}
+SLEEP=${APEX_WATCH_SLEEP:-45}
+N_PROBES=${APEX_WATCH_PROBES:-430}
 BENCH_JSON=${APEX_WATCH_BENCH_JSON:-BENCH_TPU_r5.json}
 KERN_JSON=${APEX_WATCH_KERN_JSON:-BENCH_KERNELS_TPU_r5.json}
 BENCH_LEGS=${APEX_WATCH_BENCH_LEGS:-BENCH_LEGS_r5}
 KERN_LEGS=${APEX_WATCH_KERN_LEGS:-BENCH_KERNELS_LEGS_r5}
-PROBE_CMD=${APEX_WATCH_PROBE_CMD:-'timeout 90 python -c "from apex_tpu.utils.platform import probe_ambient_backend as p
-r = p(75); print(r.detail); raise SystemExit(0 if r else 1)"'}
+PROBE_CMD=${APEX_WATCH_PROBE_CMD:-'timeout 65 python -c "from apex_tpu.utils.platform import probe_ambient_backend as p
+r = p(50); print(r.detail); raise SystemExit(0 if r else 1)"'}
 BENCH_CMD=${APEX_WATCH_BENCH_CMD:-"python bench.py --inner --legs-dir $BENCH_LEGS"}
 KERN_CMD=${APEX_WATCH_KERN_CMD:-"python bench_kernels.py --inner --legs-dir $KERN_LEGS"}
 ASSEMBLE_CMD=${APEX_WATCH_ASSEMBLE_CMD:-"python -m apex_tpu.utils.bench_legs"}
