@@ -32,6 +32,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
+# The recompute-backward kernels default to the 128-block regime that
+# jax's own pallas flash kernel picks at BERT-class shapes
+# (BlockSizes.get_default: 128 across the dkv/dq blocks).  The only
+# on-chip measurement of fwd-sized bwd blocks (512x1024, r5 first
+# capture) ran 17x slower than the XLA pair; until the
+# flash_bwd_autotune sweep lands a measured winner (tuning profile keys
+# flash_bwd_block_q/k override these), the public prior is the best
+# evidence available.
+DEFAULT_BWD_BLOCK_Q = 128
+DEFAULT_BWD_BLOCK_K = 128
 NEG_INF = -1e30
 
 # Mosaic fails at compile time (or spills) when a step's blocks exceed VMEM
@@ -58,17 +68,16 @@ def _clamp_blocks(bq, bk, D, esz, bias_per_q, bwd=False, sq=None, sk=None):
     # the backward kernels have their own optimum (the r5 on-chip sweep
     # measures them separately — fwd blocks that stream k/v differ from
     # bwd blocks that also stream do and accumulate dk/dv), so bwd=True
-    # consults the BWD env pins / tuning keys first and falls back to the
-    # shared fwd chain
-    env_q = ["APEX_TPU_FLASH_BLOCK_Q"]
-    env_k = ["APEX_TPU_FLASH_BLOCK_K"]
-    tune_q = ["flash_block_q"]
-    tune_k = ["flash_block_k"]
+    # consults ONLY the bwd env pin / tuning key / built-in chain.  The
+    # fwd winner deliberately does not leak into bwd: the one on-chip
+    # measurement of fwd-sized bwd blocks ran 17x slow, and a partial
+    # autotune window may write the fwd profile key without the bwd one.
     if bwd:
-        env_q.insert(0, "APEX_TPU_FLASH_BWD_BLOCK_Q")
-        env_k.insert(0, "APEX_TPU_FLASH_BWD_BLOCK_K")
-        tune_q.insert(0, "flash_bwd_block_q")
-        tune_k.insert(0, "flash_bwd_block_k")
+        env_q, tune_q = "APEX_TPU_FLASH_BWD_BLOCK_Q", "flash_bwd_block_q"
+        env_k, tune_k = "APEX_TPU_FLASH_BWD_BLOCK_K", "flash_bwd_block_k"
+    else:
+        env_q, tune_q = "APEX_TPU_FLASH_BLOCK_Q", "flash_block_q"
+        env_k, tune_k = "APEX_TPU_FLASH_BLOCK_K", "flash_block_k"
     # pinned = explicitly chosen, by argument OR by the env var the value
     # actually came from (docs tell users to pin the autotune winner via
     # env; a pin that got silently re-clamped would run a different
@@ -76,27 +85,27 @@ def _clamp_blocks(bq, bk, D, esz, bias_per_q, bwd=False, sq=None, sk=None):
     # PROFILE are not pins: the autotune sweeps one shape, and the VMEM
     # clamp below must still protect other shapes from a config that
     # only fit where it was measured.
-    # precedence: argument > [bwd env > bwd profile] > [env > profile]
-    # > built-in — each tier exhausted before the next, so a fwd env pin
-    # can never shadow the measured bwd profile (the bwd optimum is the
-    # whole point of the split).
+    # precedence (per path): argument > env pin > profile > built-in.
     from ...utils import tuning
 
-    def _pick(envs, tunes, default):
-        for e, t in zip(envs, tunes):
-            if e in os.environ:
-                return int(os.environ[e]), True
-            v = tuning.get_on_tpu(t, None)
-            if v is not None:
-                return int(v), False
+    def _pick(env, tune, default):
+        if env in os.environ:
+            return int(os.environ[env]), True
+        v = tuning.get_on_tpu(tune, None)
+        if v is not None:
+            return int(v), False
         return default, False
 
     bq_pinned = bq is not None
     bk_pinned = bk is not None
     if bq is None:
-        bq, bq_pinned = _pick(env_q, tune_q, DEFAULT_BLOCK_Q)
+        bq, bq_pinned = _pick(env_q, tune_q,
+                              DEFAULT_BWD_BLOCK_Q if bwd
+                              else DEFAULT_BLOCK_Q)
     if bk is None:
-        bk, bk_pinned = _pick(env_k, tune_k, DEFAULT_BLOCK_K)
+        bk, bk_pinned = _pick(env_k, tune_k,
+                              DEFAULT_BWD_BLOCK_K if bwd
+                              else DEFAULT_BLOCK_K)
     if sq is not None:
         bq = min(bq, max(8, -(-sq // 8) * 8))
     if sk is not None:

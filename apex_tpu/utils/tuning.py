@@ -7,7 +7,8 @@ winners, and every tunable default consults it at trace time:
 
   - flash-attention block sizes (``flash_block_q`` / ``flash_block_k``;
     the recompute-backward kernels' own winners ``flash_bwd_block_q`` /
-    ``flash_bwd_block_k``, falling back to the fwd keys)
+    ``flash_bwd_block_k`` — per-path chains, fwd keys never leak into
+    the bwd kernels)
   - the xentropy ``impl="auto"`` resolution (``xent_auto_impl``)
   - the flagship BERT config's attention path (``bert_attn_impl``)
   - layer-norm / MLP Pallas-vs-XLA choice (``layer_norm_use_pallas``,

@@ -405,20 +405,16 @@ def bench_flash_vmem_probe(results, on_tpu):
             import os
             est = vmem_estimate(bq, bk, D, 2, bias_per_q=False, bwd=bwd)
             prior_pins = {k: os.environ.get(k)
-                          for k in ("APEX_TPU_FLASH_BLOCK_Q",
-                                    "APEX_TPU_FLASH_BLOCK_K",
-                                    "APEX_TPU_FLASH_BWD_BLOCK_Q",
+                          for k in ("APEX_TPU_FLASH_BWD_BLOCK_Q",
                                     "APEX_TPU_FLASH_BWD_BLOCK_K")}
             if bwd:
-                # the public grad path reads blocks from the env pins at
-                # trace time; pinned values are compiled EXACTLY (no
-                # clamp), which is the point of the probe.  The BWD pins
-                # take precedence for bwd=True, so set those — and clear
-                # any ambient ones so the row compiles what it records
+                # the public grad path reads the BWD env pins at trace
+                # time; pinned values are compiled EXACTLY (no clamp),
+                # which is the point of the probe.  The fwd half of the
+                # grad jit stays at its own defaults — a compile failure
+                # in this row is then attributable to the bwd config
                 os.environ["APEX_TPU_FLASH_BWD_BLOCK_Q"] = str(bq)
                 os.environ["APEX_TPU_FLASH_BWD_BLOCK_K"] = str(bk)
-                os.environ["APEX_TPU_FLASH_BLOCK_Q"] = str(bq)
-                os.environ["APEX_TPU_FLASH_BLOCK_K"] = str(bk)
                 fn = jax.jit(lambda q_: jax.grad(lambda x: jnp.sum(
                     flash_attention(x, k, v, bias, heads=H)
                     .astype(jnp.float32)))(q_))

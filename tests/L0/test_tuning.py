@@ -93,10 +93,14 @@ def test_flash_clamp_consults_profile(profile, fake_tpu):
     # explicit arguments always win over the profile
     bq, bk = _clamp_blocks(64, 128, D=64, esz=2, bias_per_q=False)
     assert (bq, bk) == (64, 128)
-    # without bwd-specific keys, bwd falls back to the shared fwd keys
+    # the fwd profile does NOT leak into bwd (a partial autotune window
+    # may write fwd keys only; the fwd winner measured 17x slow as a bwd
+    # config): without bwd keys, bwd uses its own built-in 128-block
+    # defaults (the regime jax's flash kernel defaults to)
+    from apex_tpu.contrib.multihead_attn import flash as F
     bq, bk = _clamp_blocks(None, None, D=64, esz=2, bias_per_q=False,
                            bwd=True)
-    assert (bq, bk) == (128, 256)
+    assert (bq, bk) == (F.DEFAULT_BWD_BLOCK_Q, F.DEFAULT_BWD_BLOCK_K)
 
 
 def test_flash_clamp_bwd_keys_override_fwd(profile, fake_tpu):
@@ -114,9 +118,10 @@ def test_flash_clamp_bwd_keys_override_fwd(profile, fake_tpu):
 def test_flash_clamp_fwd_env_pin_does_not_shadow_bwd_profile(
         profile, fake_tpu, monkeypatch):
     """A user who pinned the fwd autotune winner via env must still get
-    the measured bwd profile for bwd=True: precedence is tiered
-    [bwd env, bwd profile] before [fwd env, fwd profile] (code-review
-    r5 — the flat order re-created the fwd-blocks-on-bwd pathology)."""
+    the measured bwd profile for bwd=True: the bwd path consults only
+    its own env/profile/built-in chain — fwd keys never leak into bwd
+    (code-review r5: leaking re-created the fwd-blocks-on-bwd
+    pathology)."""
     from apex_tpu.contrib.multihead_attn.flash import _clamp_blocks
     monkeypatch.setenv("APEX_TPU_FLASH_BLOCK_Q", "512")
     monkeypatch.setenv("APEX_TPU_FLASH_BLOCK_K", "1024")
