@@ -88,7 +88,8 @@ def initialize(params, optimizer=None, opt_level="O1", *,
                loss_scale=None, min_loss_scale=1.0,
                max_loss_scale=2.0 ** 24,
                allow_incoming_model_not_fp32=False,
-               cast_model_outputs=None) -> "AmpState | list[AmpState]":
+               cast_model_outputs=None,
+               flash_attn_backward=None) -> "AmpState | list[AmpState]":
     """Opt-level driven setup (``frontend.py:258-425``).
 
     params: fp32 model param pytree.  optimizer: an apex_tpu fused optimizer
@@ -121,7 +122,8 @@ def initialize(params, optimizer=None, opt_level="O1", *,
                   min_loss_scale=min_loss_scale,
                   max_loss_scale=max_loss_scale,
                   allow_incoming_model_not_fp32=allow_incoming_model_not_fp32,
-                  cast_model_outputs=cast_model_outputs)
+                  cast_model_outputs=cast_model_outputs,
+                  flash_attn_backward=flash_attn_backward)
         return [initialize(p, o, opt_level, **kw)
                 for p, o in zip(params, opts)]
 
@@ -133,11 +135,19 @@ def initialize(params, optimizer=None, opt_level="O1", *,
                       ("patch_functions", patch_functions),
                       ("keep_batchnorm_fp32", keep_batchnorm_fp32),
                       ("master_weights", master_weights),
-                      ("loss_scale", loss_scale)):
+                      ("loss_scale", loss_scale),
+                      ("flash_attn_backward", flash_attn_backward)):
         if val is not None:
             setattr(props, name, val)
     if verbosity:
         print(f"apex_tpu.amp: opt_level {opt_level} -> {props}")
+
+    # flash-attention gradient route: a session-level amp knob applied
+    # process-wide (the flash custom_vjp has no handle on AmpState) — it
+    # sits between the env override and the tuning profile in
+    # flash._resolve_backward's "auto" chain
+    from ..contrib.multihead_attn import flash as _flash
+    _flash.set_default_backward(props.flash_attn_backward)
 
     # incoming params must be fp32 unless explicitly allowed
     # (check_params_fp32, _initialize.py:79-116 gated at :170-171 by

@@ -23,7 +23,13 @@ _ALLOWED = {
     "keep_batchnorm_fp32",
     "master_weights",
     "loss_scale",
+    "flash_attn_backward",
 }
+
+# flash-attention gradient route (contrib.multihead_attn.flash): "auto"
+# defers to env/tuning-profile resolution; "pallas"/"xla" force the path
+# process-wide via flash.set_default_backward (applied by initialize()).
+_FLASH_BACKWARDS = ("auto", "pallas", "xla")
 
 
 class Properties:
@@ -43,6 +49,7 @@ class Properties:
             "keep_batchnorm_fp32": None,
             "master_weights": None,
             "loss_scale": 1.0,
+            "flash_attn_backward": "auto",
         }
 
     def _update_options_dict(self, new_options):
@@ -76,6 +83,14 @@ class Properties:
                     self.options[name] = value
                 else:
                     self.options[name] = float(value)
+            elif name == "flash_attn_backward":
+                if value is None:
+                    value = "auto"
+                if value not in _FLASH_BACKWARDS:
+                    raise ValueError(
+                        f"flash_attn_backward must be one of "
+                        f"{_FLASH_BACKWARDS}, got {value!r}")
+                self.options[name] = value
             else:
                 self.options[name] = value
         else:

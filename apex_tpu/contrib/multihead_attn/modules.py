@@ -68,13 +68,20 @@ class SelfMultiheadAttn:
       - ``"ulysses"`` — sequence-parallel via all_to_all seq<->heads
         re-sharding (num_heads must divide the axis size); same contract
         as "ring" (constructor ``causal``, no masks/dropout).
+
+    ``backward`` (flash paths only — ``impl="fast"`` and the ulysses
+    ``seq_inner_impl="fast"`` core): gradient route for the Pallas
+    forward — ``"pallas"`` recompute kernels, ``"xla"`` autodiff of the
+    equivalent XLA math (identical dropout mask), or ``"auto"``
+    (default), which consults the measured tuning profile so a recorded
+    Pallas-backward loss falls back to the XLA pair automatically.
     """
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
                  include_norm_add=False, impl="fast",
                  separate_qkv_params=False, mask_additive=False,
                  seq_parallel_axis="seq", causal=False,
-                 seq_inner_impl="default"):
+                 seq_inner_impl="default", backward="auto"):
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.dropout = dropout
@@ -94,11 +101,17 @@ class SelfMultiheadAttn:
         # long-context composition; ring's cross-device online-softmax
         # has no separate inner core to swap
         self.seq_inner_impl = seq_inner_impl
+        self.backward = backward
         if mask_additive:
             assert not include_norm_add, \
                 "additive mask not supported with layer norm"
         if impl not in ("fast", "default", "ring", "ulysses"):
             raise AssertionError(f"Unsupported impl: {impl} !")
+        from .flash import BACKWARD_IMPLS
+        if backward not in BACKWARD_IMPLS:
+            raise AssertionError(
+                f"Unsupported backward: {backward!r} (one of "
+                f"{BACKWARD_IMPLS})")
         if seq_inner_impl not in ("default", "fast"):
             raise AssertionError(
                 f"Unsupported seq_inner_impl: {seq_inner_impl} !")
@@ -213,7 +226,9 @@ class SelfMultiheadAttn:
             if self.impl == "ring":
                 seq_fn = ring_attention
             elif self.seq_inner_impl == "fast":
-                seq_fn = ulysses_flash_attention
+                import functools
+                seq_fn = functools.partial(ulysses_flash_attention,
+                                           backward=self.backward)
             else:
                 seq_fn = ulysses_attention
             ctx = seq_fn(q, k, v, axis_name=self.seq_parallel_axis,
@@ -230,7 +245,8 @@ class SelfMultiheadAttn:
                 q.reshape(B * H, S, D), k.reshape(B * H, S, D),
                 v.reshape(B * H, S, D),
                 jax.lax.stop_gradient(jnp.nan_to_num(bias, neginf=-1e30)),
-                _rng_seed_from(dropout_rng), causal, drop, H)
+                _rng_seed_from(dropout_rng), causal, drop, H,
+                self.backward)
             ctx = ctx.reshape(B, H, S, D)
         else:
             bias = build_bias(mask, self.mask_additive, batch=B, sq=S, sk=S,
@@ -260,7 +276,7 @@ class EncdecMultiheadAttn:
     decoder stream, fused KV projection (2E, E) from the encoder stream."""
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
-                 include_norm_add=False, impl="fast"):
+                 include_norm_add=False, impl="fast", backward="auto"):
         assert not bias, \
             "additive bias not supported by the reference encdec module"
         self.embed_dim = embed_dim
@@ -271,8 +287,14 @@ class EncdecMultiheadAttn:
         self.include_norm_add = include_norm_add
         self.impl = impl
         self.scaling = self.head_dim ** -0.5
+        self.backward = backward
         if impl not in ("fast", "default"):
             raise AssertionError(f"Unsupported impl: {impl} !")
+        from .flash import BACKWARD_IMPLS
+        if backward not in BACKWARD_IMPLS:
+            raise AssertionError(
+                f"Unsupported backward: {backward!r} (one of "
+                f"{BACKWARD_IMPLS})")
 
     def init_params(self, key):
         E = self.embed_dim
@@ -335,7 +357,8 @@ class EncdecMultiheadAttn:
                 qh.reshape(B * H, Sq, D), kh.reshape(B * H, Sk, D),
                 vh.reshape(B * H, Sk, D),
                 jax.lax.stop_gradient(jnp.nan_to_num(bias, neginf=-1e30)),
-                _rng_seed_from(dropout_rng), causal, drop, H)
+                _rng_seed_from(dropout_rng), causal, drop, H,
+                self.backward)
             ctx = ctx.reshape(B, H, Sq, D)
         else:
             ctx = attention_core(qh, kh, vh, bias, dropout_rate=drop,

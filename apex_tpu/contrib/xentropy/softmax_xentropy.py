@@ -31,7 +31,8 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-from ...utils.pallas import interpret_mode as _interpret
+from ...utils.pallas import (interpret_mode as _interpret,
+                             compiler_params as _compiler_params)
 
 
 # --------------------------------------------------------------------------
@@ -120,8 +121,8 @@ def _xent_fwd_pallas(logits, labels, smoothing, bn=256, bh=512):
         # rows (i) are independent; the vocab walk (j) accumulates into
         # scratch sequentially.  Same declaration the measured-fast
         # elementwise kernels carry (PERF_NOTES §2)
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "arbitrary")),
         interpret=_interpret(),
     )(lab, logits)
     return loss[:, 0], lse[:, 0]

@@ -43,7 +43,8 @@ from .flattener import LANE, DEFAULT_CHUNK
 _BR = DEFAULT_CHUNK // LANE  # block rows per grid step
 
 
-from ..utils.pallas import interpret_mode as _interpret, out_vma as _out_vma, \
+from ..utils.pallas import interpret_mode as _interpret, \
+    compiler_params as _compiler_params, out_vma as _out_vma, \
     sds as _sds, align_vma as _align_vma
 
 
@@ -95,8 +96,8 @@ def _grid_call(kernel, flats, out_dtypes, *, scalars=None, block_rows=None):
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",)),
+        compiler_params=_compiler_params(
+            ("parallel",)),
         interpret=_interpret(),
     )(*ins)
     if not isinstance(outs, (list, tuple)):
@@ -182,8 +183,8 @@ def multi_tensor_l2norm(flat_in):
         out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0),
                                memory_space=pltpu.SMEM),
         out_shape=_sds((1, 1), jnp.float32, _out_vma(flat_in)),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+        compiler_params=_compiler_params(
+            ("arbitrary",)),
         interpret=_interpret(),
     )(flat_in.reshape(rows, LANE))
     return jnp.sqrt(sumsq[0, 0])

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..utils.pallas import interpret_mode as _interpret
+from ..utils.pallas import (interpret_mode as _interpret,
+                            compiler_params as _compiler_params)
 
 
 def _kernel(activation, has_bias, x_ref, w_ref, *refs):
@@ -100,8 +101,8 @@ def fused_dense_act(x, w, b=None, activation="relu", *, block_m=256,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Mp, Np), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*ins)
     return out[:M, :N]

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..utils.pallas import interpret_mode as _interpret
+from ..utils.pallas import (interpret_mode as _interpret,
+                            compiler_params as _compiler_params)
 
 # per-block VMEM budget for the x block (fp32); leaves headroom for out +
 # double buffering within ~16 MB VMEM
@@ -131,8 +132,8 @@ def ln_fwd_pallas(x2d, weight, bias, eps):
         out_shape=[jax.ShapeDtypeStruct((rows, h), x2d.dtype),
                    jax.ShapeDtypeStruct((rows, 1), jnp.float32),
                    jax.ShapeDtypeStruct((rows, 1), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",)),
+        compiler_params=_compiler_params(
+            ("parallel",)),
         interpret=_interpret(),
     )(*ins)
     return out[:n], mean[:n], invvar[:n]
@@ -170,8 +171,8 @@ def ln_bwd_pallas(g2d, x2d, mean, invvar, weight, eps):
         in_specs=in_specs,
         out_specs=_full_spec(br, h),
         out_shape=jax.ShapeDtypeStruct((rows, h), x2d.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",)),
+        compiler_params=_compiler_params(
+            ("parallel",)),
         interpret=_interpret(),
     )(*ins)
     return dx[:n]

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, current_mesh, axis_is_bound
+from .mesh import DATA_AXIS, current_mesh, axis_is_bound, lax_axis_size
 
 
 def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
@@ -61,7 +61,7 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
     """
     if not axis_is_bound(axis_name):
         return grads
-    world = jax.lax.axis_size(axis_name)
+    world = lax_axis_size(axis_name)
 
     pre = 1.0
     post = 1.0

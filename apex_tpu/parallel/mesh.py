@@ -177,6 +177,37 @@ def axis_size(axis_name: str, mesh: Optional[Mesh] = None) -> int:
                 mesh.shape.items()).get(axis_name, 1)
 
 
+def lax_axis_size(axis_name: str) -> int:
+    """``jax.lax.axis_size`` across jax versions — the accessor only exists
+    in newer releases.  Inside a shard_map/pmap body, returns the bound
+    axis's size; the ``psum(1, axis)`` fallback is the classic idiom (a
+    unit constant summed over the axis folds to the static size at trace
+    time)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, **kwargs):
+    """``shard_map`` across jax versions: newer jax moved it out of
+    ``jax.experimental`` and renamed the replication-check kwarg
+    ``check_rep`` -> ``check_vma``.  Accepts either spelling and
+    translates to whatever the installed jax understands, so callers (and
+    the tests) can be written against the current API without pinning."""
+    import inspect
+    try:
+        from jax import shard_map as _sm
+    except ImportError:                    # pre-rename jax
+        from jax.experimental.shard_map import shard_map as _sm
+    params = inspect.signature(_sm).parameters
+    for theirs, ours in (("check_rep", "check_vma"),
+                         ("check_vma", "check_rep")):
+        if ours in kwargs and ours not in params and theirs in params:
+            kwargs[theirs] = kwargs.pop(ours)
+    return _sm(f, **kwargs)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

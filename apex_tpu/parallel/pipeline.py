@@ -24,6 +24,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from .mesh import lax_axis_size
 from ..utils.pallas import _to_varying
 
 PIPE_AXIS = "pipe"
@@ -39,7 +40,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *,
     h) -> h`` must preserve the activation shape (classic pipeline
     contract).  Returns (M, B, ...) outputs, REPLICATED on every device.
     """
-    S = jax.lax.axis_size(axis_name)
+    S = lax_axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     M = x.shape[0]
     ticks = M + S - 1

@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .mesh import SEQ_AXIS
+from .mesh import SEQ_AXIS, lax_axis_size
 from ..utils.pallas import _to_varying
 
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
@@ -64,7 +64,7 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = False,
     contiguous sequence block (device i holds positions
     [i*S_local, (i+1)*S_local)).  Returns (B, H, S_local, D).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = lax_axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -108,7 +108,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     attention per local head group (``attn_fn`` override hooks in e.g. the
     Pallas flash kernel), and converts back.  Requires H % axis_size == 0.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = lax_axis_size(axis_name)
     B, H, S_local, D = q.shape
     if H % n:
         raise ValueError(f"num_heads {H} must divide over seq axis size {n}")
@@ -143,7 +143,8 @@ def ulysses_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
 
 def ulysses_flash_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
                             causal: bool = False,
-                            scale: Optional[float] = None):
+                            scale: Optional[float] = None,
+                            backward: str = "auto"):
     """Ulysses with the Pallas flash kernel on the gathered-sequence leg.
 
     After the all_to_all each device holds its head group at FULL sequence
@@ -152,7 +153,11 @@ def ulysses_flash_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     is the long-context composition: all_to_all re-shard + flash core,
     with gradients flowing through the kernel's custom VJP and the linear
     all_to_alls.  Contrast ``ring_attention``, whose cross-device
-    online-softmax already never materializes the score matrix."""
+    online-softmax already never materializes the score matrix.
+
+    ``backward`` routes the flash core's gradient path
+    (``"pallas"|"xla"|"auto"`` — see :func:`flash_attention`); the
+    all_to_alls differentiate the same either way."""
     from ..contrib.multihead_attn.flash import flash_attention
 
     def attn_fn(qh, kh, vh, causal):
@@ -162,7 +167,8 @@ def ulysses_flash_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
         out = flash_attention(qh.reshape(B * Hl, S, D),
                               kh.reshape(B * Hl, Sk, D),
                               vh.reshape(B * Hl, Sk, D),
-                              bias, causal=causal, heads=Hl)
+                              bias, causal=causal, heads=Hl,
+                              backward=backward)
         return out.reshape(B, Hl, S, D)
 
     return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal,

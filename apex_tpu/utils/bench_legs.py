@@ -45,8 +45,17 @@ def _deep_merge(old: dict, new: dict) -> dict:
     return out
 
 
+def _scrub_keys(data: Any, keys) -> Any:
+    """Recursively drop ``keys`` from nested dicts (returns a copy)."""
+    if not isinstance(data, dict):
+        return data
+    return {k: _scrub_keys(v, keys) for k, v in data.items()
+            if k not in keys}
+
+
 def flush_leg(legs_dir: Optional[str], name: str, data: Any,
-              backend: Optional[str] = None, merge: bool = False) -> None:
+              backend: Optional[str] = None, merge: bool = False,
+              drop: tuple = ()) -> None:
     """Atomically write ``<legs_dir>/<name>.json``.  No-op when
     ``legs_dir`` is falsy.  Re-flushing the same name overwrites — legs
     that accrete results (the headline A/B) flush after every
@@ -59,7 +68,12 @@ def flush_leg(legs_dir: Optional[str], name: str, data: Any,
     destroy the previous window's already-captured measurements.
     Merging only applies when both old and new data are dicts and the
     old record's backend matches (a CPU leg must never leak values into
-    a TPU leg)."""
+    a TPU leg).
+
+    ``drop``: key names scrubbed (recursively) from the final record —
+    how renamed/retired fields leave merged artifacts: a deep-merge
+    alone would leave e.g. the pre-r5 ``pallaserror`` key standing next
+    to the new ``pallas_error`` forever (ADVICE r5 #4)."""
     if not legs_dir:
         return
     os.makedirs(legs_dir, exist_ok=True)
@@ -78,6 +92,8 @@ def flush_leg(legs_dir: Optional[str], name: str, data: Any,
         if (old is not None and old.get("backend") == backend
                 and isinstance(old.get("data"), dict)):
             data = _deep_merge(old["data"], data)
+    if drop:
+        data = _scrub_keys(data, frozenset(drop))
     rec = {"leg": name,
            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "backend": backend,
@@ -88,10 +104,12 @@ def flush_leg(legs_dir: Optional[str], name: str, data: Any,
     os.replace(tmp, os.path.join(legs_dir, f"{name}.json"))
 
 
-def make_flusher(legs_dir: Optional[str]) -> Callable[..., None]:
-    """Bind ``legs_dir`` once; benches call ``flush(name, data)``."""
+def make_flusher(legs_dir: Optional[str],
+                 drop: tuple = ()) -> Callable[..., None]:
+    """Bind ``legs_dir`` (and retired key names to scrub) once; benches
+    call ``flush(name, data)``."""
     def flush(name: str, data: Any, merge: bool = False) -> None:
-        flush_leg(legs_dir, name, data, merge=merge)
+        flush_leg(legs_dir, name, data, merge=merge, drop=drop)
     return flush
 
 

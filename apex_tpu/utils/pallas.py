@@ -9,6 +9,17 @@ def interpret_mode() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def compiler_params(dimension_semantics):
+    """TPU CompilerParams across jax versions: the class was named
+    ``TPUCompilerParams`` before jax 0.5-era releases renamed it to
+    ``CompilerParams`` — every kernel builds it through here so one jax
+    bump (or rollback) cannot break the whole Pallas surface again."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = (getattr(pltpu, "CompilerParams", None)
+           or getattr(pltpu, "TPUCompilerParams"))
+    return cls(dimension_semantics=tuple(dimension_semantics))
+
+
 def _vma_of(a):
     try:
         return jax.typeof(a).vma
@@ -17,10 +28,13 @@ def _vma_of(a):
 
 
 def _to_varying(a, axes):
-    try:
-        return jax.lax.pcast(a, axes, to="varying")
-    except AttributeError:  # pragma: no cover - jax with only legacy pvary
-        return jax.lax.pvary(a, axes)
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(a, axes, to="varying")
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:  # pragma: no cover - jax with only legacy pvary
+        return pvary(a, axes)
+    return a  # jax without vma typing: replication isn't tracked at all
 
 
 def out_vma(*arrays):

@@ -7,8 +7,12 @@ winners, and every tunable default consults it at trace time:
 
   - flash-attention block sizes (``flash_block_q`` / ``flash_block_k``;
     the recompute-backward kernels' own winners ``flash_bwd_block_q`` /
-    ``flash_bwd_block_k`` — per-path chains, fwd keys never leak into
-    the bwd kernels)
+    ``flash_bwd_block_k``, refined per-kernel by
+    ``flash_bwd_dq_block_q/k`` and ``flash_bwd_dkv_block_q/k`` — per-path
+    chains, fwd keys never leak into the bwd kernels)
+  - the flash backward route (``flash_bwd_impl``: ``backward="auto"``
+    falls back to the XLA pair when the Pallas backward measured slower)
+    and strategy (``flash_bwd_fuse``: fused one-pass vs split dq/dkv)
   - the xentropy ``impl="auto"`` resolution (``xent_auto_impl``)
   - the flagship BERT config's attention path (``bert_attn_impl``)
   - layer-norm / MLP Pallas-vs-XLA choice (``layer_norm_use_pallas``,
@@ -34,6 +38,48 @@ from __future__ import annotations
 import json
 import os
 from typing import Any, Optional
+
+# The committed profile schema: every key ``tools/apply_perf_results.py``
+# may write, with the predicate its value must satisfy.  The writer
+# validates against this before touching disk (an unknown or ill-typed
+# key means the decision engine and the consumers have drifted apart —
+# fail the write, not the training run that would silently ignore it).
+# ``_provenance`` (dict: ts/bench/kernels) rides alongside, exempt.
+_is_block = lambda v: isinstance(v, int) and not isinstance(v, bool) and v > 0
+_is_bool = lambda v: isinstance(v, bool)
+SCHEMA = {
+    "flash_block_q": _is_block,
+    "flash_block_k": _is_block,
+    "flash_bwd_block_q": _is_block,
+    "flash_bwd_block_k": _is_block,
+    "flash_bwd_dq_block_q": _is_block,
+    "flash_bwd_dq_block_k": _is_block,
+    "flash_bwd_dkv_block_q": _is_block,
+    "flash_bwd_dkv_block_k": _is_block,
+    "flash_bwd_impl": lambda v: v in ("pallas", "xla"),
+    "flash_bwd_fuse": _is_bool,
+    "xent_auto_impl": lambda v: v in ("pallas", "xla"),
+    "bert_attn_impl": lambda v: v in ("fast", "default"),
+    "layer_norm_use_pallas": _is_bool,
+    "mlp_use_pallas": _is_bool,
+    "zero_impl": lambda v: v in ("fused", "xla"),
+}
+
+
+def schema_violations(profile: dict) -> list:
+    """Schema complaints for a profile dict (empty = valid).  Unknown
+    keys and ill-typed values are both violations; ``_provenance`` and
+    other ``_``-prefixed metadata are exempt."""
+    out = []
+    for k, v in profile.items():
+        if k.startswith("_"):
+            continue
+        if k not in SCHEMA:
+            out.append(f"unknown key {k!r}")
+        elif not SCHEMA[k](v):
+            out.append(f"bad value for {k!r}: {v!r}")
+    return out
+
 
 _cache: Optional[dict] = None
 _cache_src: Optional[str] = None

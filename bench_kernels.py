@@ -177,21 +177,65 @@ def _ab_settled(rec):
 ATTN_SWEEP_LABEL = "B8 H16 D64 fwd+bwd grads(q,k,v)"
 ATTN_SWEEP_SEQS = (64, 128, 256, 512, 1024, 2048, 4096)
 
+# pre-r5 ab() records spelled the error fields 'pallaserror'/'xlaerror';
+# merged artifacts must carry only the current names (ADVICE r5 #4) — the
+# flusher scrubs these from every repaired record it writes
+LEGACY_ERR_KEYS = ("pallaserror", "xlaerror")
+
+FLASH_AUTOTUNE_LADDER = ("128x128", "128x256", "128x512", "256x512",
+                         "256x1024", "512x512", "512x1024")
+
+# the dq and dkv backward kernels tune INDEPENDENTLY (different VMEM
+# footprints, different grids); the fused one-recompute kernel gets its
+# own short ladder (its dq-partials buffer disfavors very large bk)
+FLASH_BWD_SPLIT_LADDER = ("128x128", "128x256", "256x256", "256x512",
+                          "512x512")
+FLASH_BWD_FUSED_LADDER = ("128x128", "128x256", "256x256")
+FLASH_BWD_AB_ROWS = ("pallas_grads_qkv", "xla_grads_qkv", "jax_ref_fwdbwd")
+FLASH_BWD_LABEL = "B8 H16 S1024 D64 causal per-kernel bwd + grads(q,k,v) A/B"
+# the full expected row set — completeness is keyed to THESE names, not a
+# settled-row count, so a ladder revision re-opens the section instead of
+# freezing it "complete" on stale configs (ADVICE r5 #2)
+FLASH_BWD_ROWS = (tuple(f"dq_{c}" for c in FLASH_BWD_SPLIT_LADDER)
+                  + tuple(f"dkv_{c}" for c in FLASH_BWD_SPLIT_LADDER)
+                  + tuple(f"fused_{c}" for c in FLASH_BWD_FUSED_LADDER)
+                  + FLASH_BWD_AB_ROWS)
+
+
+def _qk(cfg):
+    return tuple(int(x) for x in cfg.split("x"))
+
 
 def bench_flash_bwd_autotune(results, on_tpu, flush=lambda *a: None):
-    """Directly sweep the recompute-backward kernels' block sizes.
+    """Sweep the recompute-backward kernels' block sizes PER KERNEL, plus
+    the fair A/B that decides whether the Pallas backward ships at all.
 
     The r5 first capture measured the flash fwd+bwd at 17x SLOWER than
     the XLA pair (192.9 vs 11.1 ms at B8 H16 S1024 D64) while the fwd
     alone was fine — the pathology is in `_flash_bwd`, and the fwd-only
-    `flash_autotune` sweep cannot see it.  This leg isolates the bwd
-    (fixed fwd residuals, synthetic dO) across a (bq, bk) ladder, plus
-    one row timing jax's own pallas flash-attention as an
-    environment-sanity reference."""
+    `flash_autotune` sweep cannot see it.  This leg isolates each bwd
+    kernel (fixed fwd residuals, synthetic dO, precomputed delta):
+
+      dq_QxK    — the standalone dq kernel at (Q, K)
+      dkv_QxK   — the standalone dk/dv kernel
+      fused_QxK — the fused one-recompute kernel (dq+dk+dv in one pass)
+      pallas_grads_qkv / xla_grads_qkv — full grads(q,k,v) through the
+          custom_vjp, both rows keeping the Pallas forward exactly as
+          production does: the first with the measured best blocks
+          pinned on the Pallas backward, the second with
+          backward="xla" (_xla_bwd) — the row pair `apply_perf_results`
+          turns into the flash_bwd_impl auto-fallback decision
+      jax_ref_fwdbwd — jax's own pallas flash kernel (env sanity)
+
+    Winners land as best_dq / best_dkv / best_fused (+ legacy shared
+    `best` = the split-total winner) for the per-kernel tuning keys."""
     if not on_tpu:
         results["flash_bwd_autotune"] = {"skipped": "cpu interpret mode"}
         return
-    from apex_tpu.contrib.multihead_attn.flash import _flash_bwd, _flash_fwd
+    import os
+    from apex_tpu.contrib.multihead_attn.flash import (
+        _flash_bwd_dq, _flash_bwd_dkv, _flash_bwd_fused, _flash_fwd,
+        flash_attention)
 
     B, H, S, D = 8, 16, 1024, 64
     key = jax.random.PRNGKey(0)
@@ -209,40 +253,161 @@ def bench_flash_bwd_autotune(results, on_tpu, flush=lambda *a: None):
             out, lse = jax.jit(functools.partial(
                 _flash_fwd, causal=True, dropout_rate=0.0, seed=0,
                 heads=H))(q, k, v, bias)
-            res["out"], res["lse"] = out, lse
-            res["do"] = jax.random.normal(jax.random.PRNGKey(1), out.shape,
-                                          out.dtype)
-        return res["out"], res["lse"], res["do"]
+            do = jax.random.normal(jax.random.PRNGKey(1), out.shape,
+                                   out.dtype)
+            # delta precomputed ONCE outside the kernels, like _flash_bwd
+            delta = jnp.sum(do.astype(jnp.float32)
+                            * out.astype(jnp.float32), axis=-1,
+                            keepdims=True)
+            res.update(out=out, lse=lse, do=do, delta=delta)
+        return res["lse"], res["delta"], res["do"]
 
-    sweep = dict((results.get("flash_bwd_autotune") or {})
-                 .get("sweep_ms") or {})
-    for bq, bk in ((128, 128), (128, 256), (256, 256), (256, 512),
-                   (512, 512), (512, 1024), (1024, 1024)):
-        cfg = f"{bq}x{bk}"
-        if _row_settled(sweep.get(cfg)):
-            continue
-        fn = jax.jit(functools.partial(
-            _flash_bwd, causal=True, dropout_rate=0.0, seed=0, heads=H,
-            bq=bq, bk=bk))
-        out, lse, do = residuals()
-        try:
-            sweep[cfg] = round(slope_ms(
-                lambda q, k, v: fn(q, k, v, bias, out=out, lse=lse, do=do),
-                q, k, v), 3)
-        except Exception as err:
-            sweep[cfg] = f"failed: {repr(err)[:80]}"
-        _log(f"flash_bwd {cfg}: {sweep[cfg]}")
-        gc.collect()
-        timed = {c: t for c, t in sweep.items() if isinstance(t, float)
-                 and not c.startswith("jax_ref")}
+    prior = results.get("flash_bwd_autotune") or {}
+    if prior.get("sweep_ms") and prior.get("shape") != FLASH_BWD_LABEL:
+        # rows measured by an older ladder revision (unprefixed shared
+        # configs) must not deep-merge back under the new semantics
+        results["flash_bwd_autotune"] = {"shape": FLASH_BWD_LABEL,
+                                         "sweep_ms": {}}
+        flush("flash_bwd_autotune",
+              {"flash_bwd_autotune": results["flash_bwd_autotune"]},
+              merge=False)
+        prior = results["flash_bwd_autotune"]
+    sweep = dict(prior.get("sweep_ms") or {})
+
+    def timed(prefix):
+        return {c: sweep[f"{prefix}_{c}"] for c in
+                (FLASH_BWD_FUSED_LADDER if prefix == "fused"
+                 else FLASH_BWD_SPLIT_LADDER)
+                if isinstance(sweep.get(f"{prefix}_{c}"), float)}
+
+    def record():
+        dq_t, dkv_t, fu_t = timed("dq"), timed("dkv"), timed("fused")
+        split = {c: dq_t[c] + dkv_t[c] for c in dq_t if c in dkv_t}
         results["flash_bwd_autotune"] = {
-            "shape": f"B{B} H{H} S{S} D{D} causal bwd-only(dq,dk,dv)",
+            "shape": FLASH_BWD_LABEL,
             "sweep_ms": dict(sweep),
-            "best": min(timed, key=timed.get) if timed else None,
+            "best": min(split, key=split.get) if split else None,
+            "best_dq": min(dq_t, key=dq_t.get) if dq_t else None,
+            "best_dkv": min(dkv_t, key=dkv_t.get) if dkv_t else None,
+            "best_fused": min(fu_t, key=fu_t.get) if fu_t else None,
         }
         flush("flash_bwd_autotune",
               {"flash_bwd_autotune": results["flash_bwd_autotune"]},
               merge=True)
+
+    def measure(row, make_fn):
+        if _row_settled(sweep.get(row)):
+            return
+        try:
+            sweep[row] = round(slope_ms(make_fn(), q, k, v), 3)
+        except Exception as err:
+            sweep[row] = f"failed: {repr(err)[:80]}"
+        _log(f"flash_bwd {row}: {sweep[row]}")
+        gc.collect()
+        record()
+
+    for cfg in FLASH_BWD_SPLIT_LADDER:
+        bq, bk = _qk(cfg)
+
+        def mk_dq(bq=bq, bk=bk):
+            lse, delta, do = residuals()
+            fn = jax.jit(functools.partial(
+                _flash_bwd_dq, causal=True, dropout_rate=0.0, seed=0,
+                heads=H, bq=bq, bk=bk))
+            return lambda q, k, v: fn(q, k, v, bias, lse=lse, delta=delta,
+                                      do=do)
+
+        def mk_dkv(bq=bq, bk=bk):
+            lse, delta, do = residuals()
+            fn = jax.jit(functools.partial(
+                _flash_bwd_dkv, causal=True, dropout_rate=0.0, seed=0,
+                heads=H, bq=bq, bk=bk))
+            return lambda q, k, v: fn(q, k, v, bias, lse=lse, delta=delta,
+                                      do=do)
+
+        measure(f"dq_{cfg}", mk_dq)
+        measure(f"dkv_{cfg}", mk_dkv)
+
+    for cfg in FLASH_BWD_FUSED_LADDER:
+        bq, bk = _qk(cfg)
+
+        def mk_fused(bq=bq, bk=bk):
+            lse, delta, do = residuals()
+            fn = jax.jit(functools.partial(
+                _flash_bwd_fused, causal=True, dropout_rate=0.0, seed=0,
+                heads=H, bq=bq, bk=bk))
+            return lambda q, k, v: fn(q, k, v, bias, lse=lse, delta=delta,
+                                      do=do)
+
+        measure(f"fused_{cfg}", mk_fused)
+
+    # -- fair grads(q,k,v) A/B: the auto-fallback evidence ------------------
+    if not _row_settled(sweep.get("pallas_grads_qkv")):
+        rec = results.get("flash_bwd_autotune") or {}
+        pins = {}
+        best_fused = rec.get("best_fused")
+        best_split = (rec.get("best_dq"), rec.get("best_dkv"))
+        fu_t, dq_t, dkv_t = timed("fused"), timed("dq"), timed("dkv")
+        use_fused = (best_fused is not None and all(best_split)
+                     and fu_t[best_fused]
+                     < dq_t[best_split[0]] + dkv_t[best_split[1]])
+        pins["APEX_TPU_FLASH_BWD_FUSE"] = "1" if use_fused else "0"
+        if use_fused:
+            bq, bk = _qk(best_fused)
+            pins["APEX_TPU_FLASH_BWD_DKV_BLOCK_Q"] = str(bq)
+            pins["APEX_TPU_FLASH_BWD_DKV_BLOCK_K"] = str(bk)
+        else:
+            if best_split[0]:
+                bq, bk = _qk(best_split[0])
+                pins["APEX_TPU_FLASH_BWD_DQ_BLOCK_Q"] = str(bq)
+                pins["APEX_TPU_FLASH_BWD_DQ_BLOCK_K"] = str(bk)
+            if best_split[1]:
+                bq, bk = _qk(best_split[1])
+                pins["APEX_TPU_FLASH_BWD_DKV_BLOCK_Q"] = str(bq)
+                pins["APEX_TPU_FLASH_BWD_DKV_BLOCK_K"] = str(bk)
+        prev = {kk: os.environ.get(kk) for kk in pins}
+        os.environ.update(pins)
+        try:
+
+            def pallas_fb3(q, k, v):
+                return jax.grad(lambda q_, k_, v_: jnp.sum(
+                    flash_attention(q_, k_, v_, bias, 0, True, 0.0, H,
+                                    "pallas").astype(jnp.float32)),
+                    argnums=(0, 1, 2))(q, k, v)
+
+            sweep["pallas_grads_qkv"] = round(
+                slope_ms(jax.jit(pallas_fb3), q, k, v), 3)
+        except Exception as err:
+            sweep["pallas_grads_qkv"] = f"failed: {repr(err)[:80]}"
+        finally:
+            for kk, pv in prev.items():
+                if pv is None:
+                    os.environ.pop(kk, None)
+                else:
+                    os.environ[kk] = pv
+        _log(f"flash_bwd pallas_grads_qkv ({pins}): "
+             f"{sweep['pallas_grads_qkv']}")
+        record()
+
+    if not _row_settled(sweep.get("xla_grads_qkv")):
+        # the exact configuration backward="xla" ships: the Pallas forward
+        # + _xla_bwd (autodiff of the XLA mirror) — NOT plain attention_core,
+        # whose cheaper all-XLA fwd+bwd would bias the A/B toward a
+        # configuration production never runs (the auto route keeps the
+        # Pallas forward either way; only the gradient path differs)
+        def xla_fb3(q, k, v):
+            return jax.grad(lambda q_, k_, v_: jnp.sum(
+                flash_attention(q_, k_, v_, bias, 0, True, 0.0, H,
+                                "xla").astype(jnp.float32)),
+                argnums=(0, 1, 2))(q, k, v)
+
+        try:
+            sweep["xla_grads_qkv"] = round(
+                slope_ms(jax.jit(xla_fb3), q, k, v), 3)
+        except Exception as err:
+            sweep["xla_grads_qkv"] = f"failed: {repr(err)[:80]}"
+        _log(f"flash_bwd xla_grads_qkv: {sweep['xla_grads_qkv']}")
+        record()
 
     if not _row_settled(sweep.get("jax_ref_fwdbwd")):
         try:  # env-sanity: jax's own pallas flash kernel, full fwd+bwd
@@ -262,10 +427,7 @@ def bench_flash_bwd_autotune(results, on_tpu, flush=lambda *a: None):
         except Exception as err:
             sweep["jax_ref_fwdbwd"] = f"failed: {repr(err)[:80]}"
         _log(f"flash_bwd jax_ref_fwdbwd: {sweep['jax_ref_fwdbwd']}")
-        results["flash_bwd_autotune"]["sweep_ms"] = dict(sweep)
-        flush("flash_bwd_autotune",
-              {"flash_bwd_autotune": results["flash_bwd_autotune"]},
-              merge=True)
+        record()
 
 
 def bench_attn_seq_sweep(results, on_tpu, flush=lambda *a: None):
@@ -349,10 +511,12 @@ def bench_flash_autotune(results, on_tpu, flush=lambda *a: None):
 
     # 128-class rows added r5: jax's own flash kernel DEFAULTS to 128
     # blocks at this very shape (BlockSizes.get_default) — the sweep must
-    # cover the regime the reference implementation picked
+    # cover the regime the reference implementation picked.  The ladder
+    # constant is the single source of truth: the resume gate's
+    # settledness check keys on exactly these row names (ADVICE r5 #2)
     sweep = dict((results.get("flash_autotune") or {}).get("sweep_ms") or {})
-    for bq, bk in ((128, 128), (128, 256), (128, 512), (256, 512),
-                   (256, 1024), (512, 512), (512, 1024)):
+    for cfg in FLASH_AUTOTUNE_LADDER:
+        bq, bk = _qk(cfg)
         if _row_settled(sweep.get(f"{bq}x{bk}")):
             continue               # captured by a previous flap window
         fn = jax.jit(functools.partial(
@@ -609,12 +773,14 @@ def bench_multi_tensor(results, on_tpu):
 
 def run(budget_left=lambda: 1e9, legs_dir=None):
     from apex_tpu.utils.bench_legs import make_flusher
-    flush = make_flusher(legs_dir)
+    # every repaired record re-flushed through here sheds the pre-r5
+    # 'pallaserror'/'xlaerror' spellings a deep-merge would otherwise
+    # carry forever next to the new fields (ADVICE r5 #4)
+    flush = make_flusher(legs_dir, drop=LEGACY_ERR_KEYS)
 
     on_tpu = jax.default_backend() == "tpu"
-    _log(f"backend={jax.default_backend()} (pallas "
-         f"{'compiled' if on_tpu else 'interpret mode — timings not '
-            'meaningful'})")
+    mode = "compiled" if on_tpu else "interpret mode — timings not meaningful"
+    _log(f"backend={jax.default_backend()} (pallas {mode})")
     results = {}
     done_keys: set = set()
     # resume: with the tunnel flapping on minute-scale windows (r5: two
@@ -641,31 +807,38 @@ def run(budget_left=lambda: 1e9, legs_dir=None):
             return False
         return True
 
-    def _sweep_settled(key, field, want):
-        rows = (results[key].get(field) or {})
-        if key == "attn_seq_sweep" \
-                and results[key].get("shape") != ATTN_SWEEP_LABEL:
+    def _sweep_settled(key, field, rows_expected, label=None):
+        # completeness is keyed to the CURRENT ladder's row NAMES, not a
+        # settled-row count: counting froze the section "complete" on
+        # stale configs whenever a ladder revision renamed or added rows
+        # (ADVICE r5 #2 — the count still matched, the new rows never ran)
+        rec = results[key]
+        if label is not None and rec.get("shape") != label:
             return False           # rows from an older measurement revision
-        settled = [v for v in rows.values()
-                   if (_row_settled(v) if not isinstance(v, dict)
-                       else _ab_settled(v))]
-        return len(settled) >= want
+        rows = rec.get(field) or {}
+        return all(r in rows
+                   and (_row_settled(rows[r]) if not isinstance(rows[r], dict)
+                        else _ab_settled(rows[r]))
+                   for r in rows_expected)
 
     sections = (
         (bench_attention, ("flash_attn_fwd", "flash_attn_fwdbwd",
                            "flash_attn_fwdbwd_qkv"), None),
         (bench_xentropy, ("xentropy_fwd", "xentropy_fwdbwd"), None),
         (bench_flash_bwd_autotune, ("flash_bwd_autotune",),
-         lambda: _sweep_settled("flash_bwd_autotune", "sweep_ms", 8)),
+         lambda: _sweep_settled("flash_bwd_autotune", "sweep_ms",
+                                FLASH_BWD_ROWS, FLASH_BWD_LABEL)),
         (bench_layer_norm, ("layer_norm_fwd", "layer_norm_fwdbwd"), None),
         (bench_mlp, ("mlp_fwd", "mlp_fwdbwd"), None),
         (bench_multi_tensor, ("l2norm", "scale_flagged", "axpby_flagged",
                               "adam_update", "lamb_stage1"), None),
         (bench_flash_autotune, ("flash_autotune",),
-         lambda: _sweep_settled("flash_autotune", "sweep_ms", 7)),
+         lambda: _sweep_settled("flash_autotune", "sweep_ms",
+                                FLASH_AUTOTUNE_LADDER)),
         (bench_attn_seq_sweep, ("attn_seq_sweep",),
          lambda: _sweep_settled("attn_seq_sweep", "by_seq",
-                                len(ATTN_SWEEP_SEQS))),
+                                tuple(str(s) for s in ATTN_SWEEP_SEQS),
+                                ATTN_SWEEP_LABEL)),
         (bench_flash_vmem_probe, ("flash_vmem_probe",), None),
     )
     for fn, keys, sweep_done in sections:

@@ -116,6 +116,28 @@ def test_initialize_bad_opt_level():
         amp.initialize({}, opt_level="O9")
 
 
+def test_initialize_flash_attn_backward_knob():
+    """The amp-level flash_attn_backward option validates and lands in the
+    flash module's process default, where backward="auto" resolution picks
+    it up (between the env override and the tuning profile)."""
+    from apex_tpu.contrib.multihead_attn import flash as F
+    params = {"w": jnp.ones((4, 4))}
+    try:
+        st = amp.initialize(params, opt_level="O0", verbosity=0,
+                            flash_attn_backward="xla")
+        assert st.properties.flash_attn_backward == "xla"
+        assert F._resolve_backward("auto") == "xla"
+        # default initialize resets the process default to auto
+        st = amp.initialize(params, opt_level="O0", verbosity=0)
+        assert st.properties.flash_attn_backward == "auto"
+        assert F._DEFAULT_BACKWARD == "auto"
+    finally:
+        F.set_default_backward("auto")
+    with pytest.raises(ValueError):
+        amp.initialize(params, opt_level="O0", verbosity=0,
+                       flash_attn_backward="cuda")
+
+
 # --- end-to-end toy training -------------------------------------------------
 
 def _toy_loss(params, x, y):

@@ -343,8 +343,6 @@ _COMPLETE_LEGS = {
                   "flash_attn_fwdbwd_qkv": _ab_rec(3.0, 3.5)},
     "xentropy": {"xentropy_fwd": _ab_rec(1.4, 2.7),
                  "xentropy_fwdbwd": _ab_rec(2.8, 5.4)},
-    "flash_bwd_autotune": {"flash_bwd_autotune": {
-        "sweep_ms": {f"{b}x{b}": 1.0 for b in range(8)}, "best": "0x0"}},
     "layer_norm": {"layer_norm_fwd": _ab_rec(1.0, 1.0),
                    "layer_norm_fwdbwd": _ab_rec(1.0, 1.0)},
     "mlp": {"mlp_fwd": _ab_rec(1.0, 1.0), "mlp_fwdbwd": _ab_rec(1.0, 1.0)},
@@ -353,13 +351,10 @@ _COMPLETE_LEGS = {
                      "axpby_flagged": _ab_rec(1.0, 1.0),
                      "adam_update": _ab_rec(1.0, 1.0),
                      "lamb_stage1": _ab_rec(1.0, 1.0)},
-    "flash_autotune": {"flash_autotune": {"sweep_ms": {
-        c: 1.0 for c in ("128x128", "128x256", "128x512", "256x512",
-                         "256x1024", "512x512", "512x1024")},
-        "best": "128x512"}},
-    # attn_seq_sweep is injected per-test from the loaded module's own
-    # ATTN_SWEEP_SEQS/ATTN_SWEEP_LABEL (drift guard: the bench loop, the
-    # completeness want, and this fixture share one constant)
+    # the sweep sections (flash_autotune, flash_bwd_autotune,
+    # attn_seq_sweep) are injected per-test from the loaded module's own
+    # ladder constants (drift guard: the bench loop, the completeness
+    # row names, and this fixture share one constant — ADVICE r5 #2)
     "flash_vmem_probe": {"flash_vmem_probe": {"rows": []}},
 }
 
@@ -369,6 +364,12 @@ _SECTION_FNS = ("bench_attention", "bench_xentropy",
                 "bench_attn_seq_sweep", "bench_flash_vmem_probe")
 
 
+def _bwd_autotune_rec(bk, sweep):
+    return {"shape": bk.FLASH_BWD_LABEL, "sweep_ms": sweep,
+            "best": "128x128", "best_dq": "128x128",
+            "best_dkv": "128x128", "best_fused": "128x128"}
+
+
 def _complete_legs(bk):
     legs = dict(_COMPLETE_LEGS)
     assert bk.ATTN_SWEEP_LABEL == _SEQ_LABEL
@@ -376,6 +377,11 @@ def _complete_legs(bk):
         "shape": bk.ATTN_SWEEP_LABEL,
         "by_seq": {str(s): _ab_rec(1.0, 1.0)
                    for s in bk.ATTN_SWEEP_SEQS}}}
+    legs["flash_autotune"] = {"flash_autotune": {
+        "sweep_ms": {c: 1.0 for c in bk.FLASH_AUTOTUNE_LADDER},
+        "best": "128x512"}}
+    legs["flash_bwd_autotune"] = {"flash_bwd_autotune": _bwd_autotune_rec(
+        bk, {r: 1.0 for r in bk.FLASH_BWD_ROWS})}
     return legs
 
 
@@ -454,10 +460,11 @@ def test_kernel_bench_transient_failure_rows_do_not_settle(tmp_path,
     monkeypatch.setattr(bk.jax, "default_backend", lambda: "tpu")
     d = str(tmp_path / "legs")
     legs = _complete_legs(bk)
-    sweep = {f"{b}x{b}": 1.0 for b in range(7)}
-    sweep["7x7"] = "failed: XlaRuntimeError('INTERNAL: stream closed')"
-    legs["flash_bwd_autotune"] = {"flash_bwd_autotune": {
-        "sweep_ms": sweep, "best": "0x0"}}
+    sweep = {r: 1.0 for r in bk.FLASH_BWD_ROWS}
+    flaky_row = bk.FLASH_BWD_ROWS[0]
+    sweep[flaky_row] = "failed: XlaRuntimeError('INTERNAL: stream closed')"
+    legs["flash_bwd_autotune"] = {
+        "flash_bwd_autotune": _bwd_autotune_rec(bk, sweep)}
     for leg, data in legs.items():
         flush_leg(d, leg, data, backend="tpu")
     calls = []
@@ -466,12 +473,36 @@ def test_kernel_bench_transient_failure_rows_do_not_settle(tmp_path,
     assert calls == ["bench_flash_bwd_autotune"]    # transient -> retry
 
     # flip the row to a permanent Mosaic failure: now settled, no re-run
-    sweep["7x7"] = "failed: Mosaic lowering: RESOURCE_EXHAUSTED vmem"
-    flush_leg(d, "flash_bwd_autotune", {"flash_bwd_autotune": {
-        "sweep_ms": sweep, "best": "0x0"}}, backend="tpu")
+    sweep[flaky_row] = "failed: Mosaic lowering: RESOURCE_EXHAUSTED vmem"
+    flush_leg(d, "flash_bwd_autotune", {
+        "flash_bwd_autotune": _bwd_autotune_rec(bk, sweep)}, backend="tpu")
     calls.clear()
     bk.run(legs_dir=d)
     assert calls == []
+
+
+def test_kernel_bench_ladder_revision_reopens_sweep(tmp_path, monkeypatch):
+    """A leg captured by an OLDER ladder (enough settled rows to fool a
+    count, but different row names/label) must not freeze the section
+    "complete" — completeness keys on the current ladder's row NAMES
+    (ADVICE r5 #2: the r5 gate counted 8 settled rows, so the r5-shaped
+    record below would have skipped the rebuilt per-kernel sweep forever)."""
+    bk = _load_kernels()
+    monkeypatch.setattr(bk.jax, "default_backend", lambda: "tpu")
+    d = str(tmp_path / "legs")
+    legs = _complete_legs(bk)
+    legs["flash_bwd_autotune"] = {"flash_bwd_autotune": {
+        "shape": "B8 H16 S1024 D64 causal bwd-only(dq,dk,dv)",
+        "sweep_ms": {c: 1.0 for c in ("128x128", "128x256", "256x256",
+                                      "256x512", "512x512", "512x1024",
+                                      "1024x1024", "jax_ref_fwdbwd")},
+        "best": "128x128"}}
+    for leg, data in legs.items():
+        flush_leg(d, leg, data, backend="tpu")
+    calls = []
+    _patch_sections(bk, monkeypatch, calls)
+    bk.run(legs_dir=d)
+    assert calls == ["bench_flash_bwd_autotune"]
 
 
 def test_kernel_bench_seq_sweep_stale_semantics_reset(tmp_path, monkeypatch):

@@ -14,10 +14,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map
-except ImportError:  # older jax layout
-    from jax.experimental.shard_map import shard_map
+from apex_tpu.parallel.mesh import shard_map   # check_vma/check_rep compat
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.contrib.optimizers import (DistributedFusedAdam,
@@ -69,7 +66,12 @@ def run_sharded(opt, params, n_dev=8, iters=ITERS, mesh=None, specs=None,
     specs = specs if specs is not None else P(*(mesh.axis_names))
     gspec = jax.tree_util.tree_map(lambda _: specs, params)
     sspec = opt.state_pspecs()
-    vma_kw = {"check_vma": False} if opt.impl == "fused" else {}
+    # the replication-typing validation additionally needs a jax with vma
+    # typing: the 0.4-era check_rep cannot infer the allgathered outputs
+    # replicated and rejects the step wholesale
+    has_vma = hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast")
+    vma_kw = ({"check_vma": False}
+              if opt.impl == "fused" or not has_vma else {})
 
     @functools.partial(
         shard_map, mesh=mesh,

@@ -9,10 +9,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map
-except ImportError:  # older jax layout
-    from jax.experimental.shard_map import shard_map
+from apex_tpu.parallel.mesh import shard_map   # check_vma/check_rep compat
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.parallel.expert import MoELayer, moe_ffn

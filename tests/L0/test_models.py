@@ -55,7 +55,7 @@ def test_resnet_syncbn_matches_large_batch(tiny_rn):
     """SyncBN over a shard_map'd batch == plain BN on the full batch — the
     two_gpu_unit_test.py oracle, on a CPU device mesh."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from apex_tpu.parallel.mesh import shard_map
 
     cfg, params, state = tiny_rn
     n_dev = min(4, len(jax.devices()))

@@ -251,6 +251,258 @@ def test_flash_fully_masked_rows_emit_zeros():
     np.testing.assert_array_equal(np.asarray(g), 0.0)
 
 
+# ---------------------------------------------------------------------------
+# selectable backward backend (backward="pallas"|"xla"|"auto")
+# ---------------------------------------------------------------------------
+
+def _ref_attention(q, k, v, bias, causal, heads):
+    """jax.nn reference on (BH, S, D) layouts — the parity oracle for the
+    backward-backend tests (no dropout; dead rows not exercised here)."""
+    Sq, Sk = q.shape[1], k.shape[1]
+    s = jnp.einsum("bqd,bkd->bqk", q, k)
+    b = bias
+    if b.shape[0] != 1:
+        b = jnp.repeat(b, heads, axis=0)
+    s = s + b
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((Sq, Sk), bool))[None], s, -1e30)
+    return jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, axis=-1), v)
+
+
+def _bias_layouts(b, sq, sk):
+    """The three supported additive-bias layouts: none, per-batch
+    key-padding (B, 1, Sk), full per-query score mask (B, Sq, Sk)."""
+    pad = jnp.zeros((b, 1, sk), jnp.float32).at[:, :, sk - 8:].set(-1e30)
+    full = jnp.zeros((b, sq, sk), jnp.float32).at[:, sq // 2:, :4].set(-1e9)
+    return {"none": jnp.zeros((1, 1, sk), jnp.float32),
+            "padding": pad, "full": full}
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("layout", ["none", "padding", "full"])
+def test_flash_backward_xla_matches_pallas_and_reference(causal, layout):
+    """backward="xla" and backward="pallas" produce matching (q, k, v)
+    gradients, and both match autodiff of the jax.nn reference — across
+    causal x bias layouts (the acceptance parity matrix)."""
+    b, h, s, d = 2, 2, 48, 16
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, (b * h, s, d), jnp.float32) * 0.5
+               for kk in ks)
+    bias = _bias_layouts(b, s, s)[layout]
+
+    def loss(backend):
+        return lambda q, k, v: flash_attention(
+            q, k, v, bias, 0, causal, 0.0, h, backend).sum()
+
+    g_pl = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: _ref_attention(
+        q, k, v, bias, causal, h).sum(), argnums=(0, 1, 2))(q, k, v)
+    for name, a, bb in zip("qkv", g_pl, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=5e-3, rtol=1e-3, err_msg=name)
+    for name, a, r in zip("qkv", g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=5e-3, rtol=1e-3, err_msg=name)
+
+
+def test_flash_backward_xla_matches_pallas_with_dropout():
+    """With dropout the two routes share the counter-based keep mask
+    bit-for-bit, so their gradients must agree exactly as closely as the
+    no-dropout pair (the jax.nn oracle can't see the mask, so the A/B is
+    pallas-vs-xla only here)."""
+    h, s, d = 2, 64, 16
+    ks = jax.random.split(jax.random.PRNGKey(12), 3)
+    q, k, v = (jax.random.normal(kk, (h, s, d), jnp.float32) for kk in ks)
+    bias = jnp.zeros((1, 1, s), jnp.float32)
+
+    def loss(backend):
+        return lambda q, k, v: flash_attention(
+            q, k, v, bias, 7, True, 0.3, h, backend).sum()
+
+    g_pl = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+    for name, a, bb in zip("qkv", g_pl, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=5e-3, rtol=1e-3, err_msg=name)
+
+
+@pytest.mark.parametrize("causal,rate", [(False, 0.0), (True, 0.0),
+                                         (True, 0.3)])
+def test_flash_bwd_fused_matches_split(monkeypatch, causal, rate):
+    """The fused one-recompute kernel and the split dq/dkv kernels are the
+    same math: forcing each strategy via APEX_TPU_FLASH_BWD_FUSE must give
+    matching gradients (incl. the causal dq-partial zero-fill path and the
+    shared dropout-mask regeneration)."""
+    h, s, d = 2, 64, 16
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q, k, v = (jax.random.normal(kk, (h, s, d), jnp.float32) for kk in ks)
+    bias = jnp.zeros((1, 1, s), jnp.float32)
+
+    def grads():
+        return jax.grad(lambda q, k, v: flash_attention(
+            q, k, v, bias, 5, causal, rate, h, "pallas").sum(),
+            argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_FUSE", "1")
+    g_fused = grads()
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_FUSE", "0")
+    g_split = grads()
+    for name, a, bb in zip("qkv", g_fused, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=1e-5, rtol=1e-5, err_msg=name)
+
+
+def test_flash_backward_auto_resolution_chain(monkeypatch):
+    """backward="auto" resolves env > amp-config default > tuning profile
+    > pallas built-in; explicit arguments bypass the chain entirely."""
+    from apex_tpu.contrib.multihead_attn import flash as F
+    from apex_tpu.utils import tuning
+    monkeypatch.delenv("APEX_TPU_FLASH_BWD_IMPL", raising=False)
+    assert F._resolve_backward("auto") == "pallas"      # built-in
+    # a recorded Pallas-backward loss in the profile flips auto to xla
+    monkeypatch.setattr(tuning, "get_on_tpu",
+                        lambda key, default=None:
+                        "xla" if key == "flash_bwd_impl" else default)
+    assert F._resolve_backward("auto") == "xla"
+    # the amp-config default beats the profile
+    F.set_default_backward("pallas")
+    try:
+        assert F._resolve_backward("auto") == "pallas"
+    finally:
+        F.set_default_backward("auto")
+    # env beats both
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_IMPL", "pallas")
+    assert F._resolve_backward("auto") == "pallas"
+    # explicit argument beats everything
+    assert F._resolve_backward("xla") == "xla"
+    with pytest.raises(ValueError):
+        F._resolve_backward("cuda")
+    with pytest.raises(ValueError):
+        F.set_default_backward("cuda")
+
+
+def test_flash_backward_auto_routes_to_xla_on_recorded_loss(monkeypatch):
+    """Functional proof of the auto-fallback: with the tuning profile
+    recording a Pallas-bwd loss, a grad through backward="auto" runs the
+    XLA backward (and matches the Pallas kernels numerically)."""
+    from apex_tpu.contrib.multihead_attn import flash as F
+    from apex_tpu.utils import tuning
+    monkeypatch.delenv("APEX_TPU_FLASH_BWD_IMPL", raising=False)
+    monkeypatch.setattr(tuning, "get_on_tpu",
+                        lambda key, default=None:
+                        "xla" if key == "flash_bwd_impl" else default)
+    h, s, d = 2, 32, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (h, s, d))
+    bias = jnp.zeros((1, 1, s), jnp.float32)
+    routed = {}
+    real_xla_bwd = F._xla_bwd
+
+    def spy(*args, **kw):
+        routed["xla"] = True
+        return real_xla_bwd(*args, **kw)
+
+    monkeypatch.setattr(F, "_xla_bwd", spy)
+    g_auto = jax.grad(lambda q: flash_attention(
+        q, q, q, bias, 0, True, 0.0, h, "auto").sum())(q)
+    assert routed.get("xla"), "auto did not route the backward to XLA"
+    g_pl = jax.grad(lambda q: flash_attention(
+        q, q, q, bias, 0, True, 0.0, h, "pallas").sum())(q)
+    np.testing.assert_allclose(np.asarray(g_auto), np.asarray(g_pl),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_flash_backward_arg_validated_at_call_site():
+    """A bogus backward= raises at the flash_attention call on BOTH the
+    inference and the training path — not at the first backward trace."""
+    h, s, d = 1, 16, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (h, s, d))
+    bias = jnp.zeros((1, 1, s), jnp.float32)
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q, bias, 0, False, 0.0, h, "cuda")
+    with pytest.raises(ValueError):
+        jax.grad(lambda q: flash_attention(q, q, q, bias, 0, False, 0.0,
+                                           h, "cuda").sum())(q)
+
+
+def test_module_backward_knob_validated():
+    with pytest.raises(AssertionError):
+        SelfMultiheadAttn(E, H, backward="cuda")
+    with pytest.raises(AssertionError):
+        EncdecMultiheadAttn(E, H, backward="cuda")
+    # the knob threads through the module fwd+bwd without disturbing parity
+    q, _ = _inputs(sq=32, b=2, seed=4)
+    m_x = SelfMultiheadAttn(E, H, impl="fast", backward="xla")
+    m_p = SelfMultiheadAttn(E, H, impl="fast", backward="pallas")
+    params = m_x.init_params(jax.random.PRNGKey(0))
+    gx = jax.grad(lambda p: (m_x(p, q, is_training=False)[0] ** 2).sum())(
+        params)
+    gp = jax.grad(lambda p: (m_p(p, q, is_training=False)[0] ** 2).sum())(
+        params)
+    for a, b in zip(jax.tree_util.tree_leaves(gx),
+                    jax.tree_util.tree_leaves(gp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-2, rtol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# dropout mask statistics (the counter-based keep hash)
+# ---------------------------------------------------------------------------
+
+def _keep_mask(seed, bh, row0=0, col0=0, shape=(512, 512), rate=0.5):
+    from apex_tpu.contrib.multihead_attn.flash import _dropout_keep
+    return np.asarray(_dropout_keep(jnp.int32(seed), jnp.int32(bh),
+                                    row0, col0, shape, rate))
+
+
+def test_dropout_keep_rate_uniform():
+    """Keep-rate within binomial tolerance of 1-rate at scale (n=2^18 per
+    mask; 0.01 is ~10 sigma at rate 0.5 — a biased hash fails, noise
+    doesn't)."""
+    for rate in (0.1, 0.3, 0.5, 0.7, 0.9):
+        frac = _keep_mask(123, 5, rate=rate).mean()
+        assert abs(frac - (1.0 - rate)) < 0.01, (rate, frac)
+    # and per-row / per-column: no stripes (the hash mixes rows and cols
+    # with different odd constants; a weak mix shows up as row bias)
+    m = _keep_mask(7, 3, rate=0.5)
+    assert np.abs(m.mean(axis=0) - 0.5).max() < 0.12     # cols, n=512 each
+    assert np.abs(m.mean(axis=1) - 0.5).max() < 0.12     # rows
+
+
+def test_dropout_mask_independence_at_scale():
+    """Masks across different (seed, batch-head, block-offset) coordinates
+    are pairwise ~independent: agreement with the base mask stays near the
+    0.5 expected of independent fair coins (n=2^18, so 0.52 is ~20 sigma),
+    and no variant reproduces the base mask exactly."""
+    base = _keep_mask(1, 0)
+    variants = {
+        "seed+1": _keep_mask(2, 0),
+        "seed+7919": _keep_mask(1 + 7919, 0),   # the round-1 collision pair
+        "head+1": _keep_mask(1, 1),
+        "head+7919": _keep_mask(1, 7919),
+        "row-offset": _keep_mask(1, 0, row0=512),
+        "col-offset": _keep_mask(1, 0, col0=512),
+        "row+col-offset": _keep_mask(1, 0, row0=512, col0=512),
+    }
+    for name, m in variants.items():
+        agree = (base == m).mean()
+        assert 0.48 < agree < 0.52, (name, agree)
+    # the historical regression: (seed, head) pairs colliding — seed s
+    # with head b must not reuse the mask of seed s+7919 with head b'
+    cross = _keep_mask(1 + 7919, 1)
+    assert 0.48 < (base == cross).mean() < 0.52
+    assert not np.array_equal(base, cross)
+
+
+def test_dropout_mask_block_offset_consistency():
+    """A mask generated at a block offset equals the corresponding slice of
+    the full mask — the property that makes masks identical across the
+    fwd/dq/dkv/fused kernels' different grid shapes."""
+    full = _keep_mask(42, 2, shape=(256, 256), rate=0.3)
+    sub = _keep_mask(42, 2, row0=128, col0=64, shape=(128, 192), rate=0.3)
+    np.testing.assert_array_equal(sub, full[128:, 64:256])
+
+
 def test_flash_block_clamp():
     """VMEM-budget clamp: defaults fit an 8 MiB budget at common head dims;
     a tiny budget forces aligned shrink on env-defaulted blocks; explicit

@@ -86,6 +86,14 @@ def _run_two_process(worker_filename, timeout=120, attempts=3,
         outs = [p.communicate()[0] for p in procs]   # reap + collect
         if abort is None and all(p.returncode == 0 for p in procs):
             return list(zip(procs, outs))
+        if any("aren't implemented on the CPU backend" in o for o in outs):
+            # deterministic capability error, not a cluster-formation
+            # race: this jax's CPU client refuses cross-process
+            # computations outright, and no retry (or test) can change
+            # that — skip instead of burning attempts on a guaranteed
+            # failure that would read as a code regression
+            import pytest
+            pytest.skip("jax CPU backend lacks multiprocess computations")
         failures.append(
             f"[{abort or 'exit'} rcs={[p.returncode for p in procs]}]\n"
             + "\n---\n".join(o[-1000:] for o in outs if o))

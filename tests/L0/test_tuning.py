@@ -232,8 +232,23 @@ def _tpu_artifacts():
             "kernels": {
                 "flash_autotune": {"best": "256x1024",
                                    "sweep_ms": {"256x1024": 1.2}},
-                "flash_bwd_autotune": {"best": "128x256",
-                                       "sweep_ms": {"128x256": 3.0}},
+                # the r6 per-kernel ladder: dq and dkv winners differ, the
+                # fused strategy beats the split total, and the fair
+                # grads(q,k,v) A/B records a Pallas-backward LOSS (the
+                # auto-fallback case the loop exists for)
+                "flash_bwd_autotune": {
+                    "shape": "B8 H16 S1024 D64 causal per-kernel bwd + "
+                             "grads(q,k,v) A/B",
+                    "best": "128x256",
+                    "best_dq": "128x256", "best_dkv": "256x256",
+                    "best_fused": "128x256",
+                    "sweep_ms": {
+                        "dq_128x128": 1.4, "dq_128x256": 1.0,
+                        "dkv_128x128": 2.0, "dkv_128x256": 1.9,
+                        "dkv_256x256": 1.8,
+                        "fused_128x128": 2.9, "fused_128x256": 2.5,
+                        "pallas_grads_qkv": 5.0, "xla_grads_qkv": 3.0,
+                        "jax_ref_fwdbwd": 11.0}},
                 "xentropy_fwdbwd": {"speedup": 1.3},
                 "layer_norm_fwdbwd": {"speedup": 0.8},
                 "mlp_fwdbwd": {"speedup": 1.1},
@@ -253,6 +268,18 @@ def test_decide_applies_rules():
     assert prof["flash_block_q"] == 256 and prof["flash_block_k"] == 1024
     assert prof["flash_bwd_block_q"] == 128
     assert prof["flash_bwd_block_k"] == 256
+    # per-kernel winners refine the shared keys independently
+    assert prof["flash_bwd_dq_block_q"] == 128
+    assert prof["flash_bwd_dq_block_k"] == 256
+    # best fused (2.5) beats best dq + best dkv (1.0 + 1.8 = 2.8)
+    assert prof["flash_bwd_fuse"] is True
+    # with fuse=True the dkv keys carry best_FUSED (128x256), not
+    # best_dkv (256x256): the fused kernel runs on the dkv grid and reads
+    # these keys, and must get the config its win was measured at
+    assert prof["flash_bwd_dkv_block_q"] == 128
+    assert prof["flash_bwd_dkv_block_k"] == 256
+    # the A/B recorded pallas 5.0 vs xla 3.0: auto must route to XLA
+    assert prof["flash_bwd_impl"] == "xla"
     assert prof["xent_auto_impl"] == "pallas"
     assert prof["layer_norm_use_pallas"] is False
     assert prof["mlp_use_pallas"] is True
@@ -337,3 +364,219 @@ def test_cli_writes_profile_and_notes(tmp_path):
     txt = notes.read_text()
     assert "stale" not in txt
     assert txt.count("Measured winners applied") == 1
+
+
+def test_decide_skips_non_config_winner():
+    """A non-config row name landing in a ``best*`` field (e.g. the
+    ``jax_ref_fwdbwd`` sanity row) must SKIP the key, not crash decide()
+    with a ValueError from int() — ADVICE r5 #3."""
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    bt = kern["kernels"]["flash_bwd_autotune"]
+    bt["best"] = "jax_ref_fwdbwd"
+    kern["kernels"]["flash_autotune"]["best"] = "jax_ref_fwdbwd"
+    # force the split path (fused rows lose) and poison its winner: the
+    # dkv keys must be SKIPPED, not crash decide()
+    for c in list(bt["sweep_ms"]):
+        if c.startswith("fused_"):
+            bt["sweep_ms"][c] = 99.0
+    bt["best_dkv"] = "failed: Mosaic"
+    prof, _ = mod.decide(bench, kern)          # must not raise
+    assert "flash_block_q" not in prof
+    assert "flash_bwd_block_q" not in prof
+    assert "flash_bwd_dkv_block_q" not in prof
+    assert prof["flash_bwd_fuse"] is False
+    assert prof["flash_bwd_dq_block_q"] == 128  # valid winners still land
+
+
+def test_decide_fuse_loses_ships_best_dkv():
+    """When the split total wins, the dkv keys carry best_dkv — the split
+    kernel is what production runs."""
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    sweep = kern["kernels"]["flash_bwd_autotune"]["sweep_ms"]
+    sweep["fused_128x128"] = 9.0
+    sweep["fused_128x256"] = 8.5       # worst fused (8.5) > split (2.8)
+    prof, _ = mod.decide(bench, kern)
+    assert prof["flash_bwd_fuse"] is False
+    assert prof["flash_bwd_dkv_block_q"] == 256   # best_dkv
+    assert prof["flash_bwd_dkv_block_k"] == 256
+
+
+def test_decide_fuse_win_with_unparsable_best_fused_skips_dkv_keys():
+    """fuse=true must never ship dkv keys taken from best_dkv: when
+    best_fused is absent/unparsable the keys are skipped entirely (the
+    runtime falls back to its 128x128 built-in — a config the fused
+    ladder DID measure — rather than a split-only winner it didn't)."""
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    kern["kernels"]["flash_bwd_autotune"]["best_fused"] = "stale-garbage"
+    prof, _ = mod.decide(bench, kern)
+    assert prof["flash_bwd_fuse"] is True
+    assert "flash_bwd_dkv_block_q" not in prof
+    assert "flash_bwd_dkv_block_k" not in prof
+
+
+def test_decide_failed_fused_ladder_records_fuse_false():
+    """A fused ladder with no measured row must write flash_bwd_fuse=False:
+    leaving the key absent would let the runtime byte-cap heuristic
+    re-enable the kernel that just failed on this chip."""
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    bt = kern["kernels"]["flash_bwd_autotune"]
+    for c in list(bt["sweep_ms"]):
+        if c.startswith("fused_"):
+            bt["sweep_ms"][c] = "failed: Mosaic lowering"
+    bt["best_fused"] = None
+    prof, _ = mod.decide(bench, kern)
+    assert prof["flash_bwd_fuse"] is False
+    assert prof["flash_bwd_dkv_block_q"] == 256   # split keys still land
+
+
+def test_schema_violations():
+    """The committed profile schema: unknown keys and ill-typed values are
+    violations; ``_``-prefixed metadata is exempt."""
+    good = {"flash_block_q": 128, "flash_bwd_dq_block_q": 256,
+            "flash_bwd_impl": "xla", "flash_bwd_fuse": True,
+            "_provenance": {"ts": "2026"}}
+    assert tuning.schema_violations(good) == []
+    assert tuning.schema_violations({"mystery_knob": 1})
+    assert tuning.schema_violations({"flash_block_q": True})  # bool != block
+    assert tuning.schema_violations({"flash_block_q": -8})
+    assert tuning.schema_violations({"flash_bwd_impl": "cuda"})
+    assert tuning.schema_violations({"flash_bwd_fuse": 1})    # int != bool
+
+
+def test_cli_schema_gate_blocks_drifted_profile(tmp_path, monkeypatch):
+    """A decision engine emitting a key the consumers don't know must fail
+    the write, not ship a profile the training run silently ignores."""
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    bpath = tmp_path / "b.json"
+    bpath.write_text(json.dumps(bench))
+    kpath = tmp_path / "k.json"
+    kpath.write_text(json.dumps(kern))
+    out = tmp_path / "tuned.json"
+    monkeypatch.setattr(mod, "decide",
+                        lambda b, k: ({"mystery_knob": 1},
+                                      [("mystery_knob", "1", "synthetic")]))
+    rc = mod.main(["--bench", str(bpath), "--kernels", str(kpath),
+                   "--out", str(out)])
+    assert rc == 1
+    assert not out.exists()
+
+
+_FLASH_ENV = ("APEX_TPU_FLASH_BLOCK_Q", "APEX_TPU_FLASH_BLOCK_K",
+              "APEX_TPU_FLASH_BWD_BLOCK_Q", "APEX_TPU_FLASH_BWD_BLOCK_K",
+              "APEX_TPU_FLASH_BWD_DQ_BLOCK_Q", "APEX_TPU_FLASH_BWD_DQ_BLOCK_K",
+              "APEX_TPU_FLASH_BWD_DKV_BLOCK_Q",
+              "APEX_TPU_FLASH_BWD_DKV_BLOCK_K",
+              "APEX_TPU_FLASH_BWD_IMPL", "APEX_TPU_FLASH_BWD_FUSE",
+              "APEX_TPU_FLASH_VMEM_MB")
+
+
+def test_flash_clamp_per_kernel_chains(profile, fake_tpu, monkeypatch):
+    """The dq/dkv backward kernels resolve blocks through their own chains:
+    argument > per-kernel env > shared bwd env > per-kernel profile >
+    shared bwd profile > built-in.  The fused kernel rides the dkv chain
+    (it runs on the dkv grid)."""
+    from apex_tpu.contrib.multihead_attn.flash import _clamp_blocks
+    for var in _FLASH_ENV:
+        monkeypatch.delenv(var, raising=False)
+    profile({"flash_bwd_block_q": 128, "flash_bwd_block_k": 128,
+             "flash_bwd_dq_block_q": 256, "flash_bwd_dq_block_k": 256})
+    # per-kernel profile beats the shared profile key...
+    assert _clamp_blocks(None, None, 64, 2, False, bwd="dq") == (256, 256)
+    # ...while a kernel without per-kernel keys falls back to shared
+    assert _clamp_blocks(None, None, 64, 2, False, bwd="dkv") == (128, 128)
+    assert _clamp_blocks(None, None, 64, 2, False, bwd="fused") == (128, 128)
+    # legacy shared-model callers (bwd=True) see shared keys only
+    assert _clamp_blocks(None, None, 64, 2, False, bwd=True) == (128, 128)
+    # a shared bwd env pin beats the per-kernel PROFILE (env > profile)
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_BLOCK_Q", "512")
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_BLOCK_K", "512")
+    assert _clamp_blocks(None, None, 64, 2, False, bwd="dq") == (512, 512)
+    # a per-kernel env pin beats the shared env pin, for its kernel only
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_DQ_BLOCK_Q", "128")
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_DQ_BLOCK_K", "128")
+    assert _clamp_blocks(None, None, 64, 2, False, bwd="dq") == (128, 128)
+    assert _clamp_blocks(None, None, 64, 2, False, bwd="dkv") == (512, 512)
+    # the fwd chain never sees any of it
+    assert _clamp_blocks(None, None, 64, 2, False) == (512, 1024)
+
+
+def test_resolve_fuse_chain(profile, fake_tpu, monkeypatch):
+    """Fused-vs-split: explicit arg > env > profile > buffer-cap
+    heuristic."""
+    from apex_tpu.contrib.multihead_attn import flash as F
+    monkeypatch.delenv("APEX_TPU_FLASH_BWD_FUSE", raising=False)
+    monkeypatch.delenv("APEX_TPU_FLASH_BWD_FUSE_MB", raising=False)
+    # heuristic: small dq-partials buffer -> fuse; past the cap -> split
+    assert F._resolve_fuse(None, 4, 128, 128, 64, 128) is True
+    assert F._resolve_fuse(None, 64, 16384, 16384, 64, 128) is False
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_FUSE_MB", "0.001")
+    assert F._resolve_fuse(None, 4, 128, 128, 64, 128) is False
+    monkeypatch.delenv("APEX_TPU_FLASH_BWD_FUSE_MB")
+    # profile beats the heuristic
+    profile({"flash_bwd_fuse": False})
+    assert F._resolve_fuse(None, 4, 128, 128, 64, 128) is False
+    # env beats the profile
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_FUSE", "1")
+    assert F._resolve_fuse(None, 4, 128, 128, 64, 128) is True
+    # explicit argument beats everything
+    assert F._resolve_fuse(False, 4, 128, 128, 64, 128) is False
+
+
+def test_tuning_loop_closes_end_to_end(tmp_path, fake_tpu, monkeypatch):
+    """The full produce -> decide -> consume cycle on CPU: a synthetic
+    BENCH_KERNELS_*.json flows through the apply_perf_results CLI into a
+    schema-valid tuned_defaults.json, whose dq/dkv block keys and
+    flash_bwd_impl route _clamp_blocks and backward="auto" — with env
+    pins still beating the written profile (the documented precedence)."""
+    for var in _FLASH_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench, kern = _tpu_artifacts()
+    bpath = tmp_path / "BENCH_TPU_x.json"
+    bpath.write_text(json.dumps(bench))
+    kpath = tmp_path / "BENCH_KERNELS_TPU_x.json"
+    kpath.write_text(json.dumps(kern))
+    out = tmp_path / "tuned_defaults.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "apply_perf_results.py"),
+         "--bench", str(bpath), "--kernels", str(kpath), "--out", str(out)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    assert r.returncode == 0, r.stderr
+
+    # the written artifact carries the documented schema
+    prof = json.loads(out.read_text())
+    assert tuning.schema_violations(prof) == []
+    assert prof["flash_bwd_dq_block_q"] == 128
+    assert prof["flash_bwd_dq_block_k"] == 256
+    # fuse won, so the dkv keys (which the fused kernel reads) carry the
+    # measured fused winner, not the split dkv winner
+    assert prof["flash_bwd_dkv_block_q"] == 128
+    assert prof["flash_bwd_dkv_block_k"] == 256
+    assert prof["flash_bwd_fuse"] is True
+    assert prof["flash_bwd_impl"] == "xla"
+    assert prof["_provenance"]["kernels"] == "BENCH_KERNELS_TPU_x.json"
+
+    # the consumers pick the written keys up (on the TPU backend)
+    monkeypatch.setenv("APEX_TPU_TUNING_FILE", str(out))
+    tuning.reload()
+    from apex_tpu.contrib.multihead_attn import flash as F
+    assert F._clamp_blocks(None, None, 64, 2, False, bwd="dq") == (128, 256)
+    assert F._clamp_blocks(None, None, 64, 2, False, bwd="dkv") == (128, 256)
+    # the recorded Pallas-backward loss provably flips auto to XLA
+    assert F._resolve_backward("auto") == "xla"
+    # the measured fuse decision beats the byte-cap heuristic
+    assert F._resolve_fuse(None, 64, 16384, 16384, 64, 128) is True
+
+    # env pins still win over the written profile
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_DQ_BLOCK_Q", "512")
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_DQ_BLOCK_K", "512")
+    assert F._clamp_blocks(None, None, 64, 2, False, bwd="dq") == (512, 512)
+    assert F._clamp_blocks(None, None, 64, 2, False, bwd="dkv") == (128, 256)
+    monkeypatch.setenv("APEX_TPU_FLASH_BWD_IMPL", "pallas")
+    assert F._resolve_backward("auto") == "pallas"

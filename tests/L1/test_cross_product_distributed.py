@@ -19,10 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:                       # older jax layout
-    from jax.experimental.shard_map import shard_map
+from apex_tpu.parallel.mesh import shard_map   # check_vma/check_rep compat
 
 from apex_tpu import amp
 from apex_tpu.optimizers import FusedSGD
@@ -56,11 +53,16 @@ def run_config_dp(opt_level, loss_scale=None, steps=STEPS):
     mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("data",))
     rep = jax.tree_util.tree_map(lambda _: P(), (state, bn_state))
 
+    # the replicated-out_specs typing is only inferable on a jax with vma
+    # typing; the 0.4-era check_rep rejects the psum'd updates wholesale
+    has_vma = hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast")
+
     @jax.jit
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(rep[0], rep[1], P("data"), P("data")),
-        out_specs=(rep[0], rep[1], P()))
+        out_specs=(rep[0], rep[1], P()),
+        **({} if has_vma else {"check_vma": False}))
     def step(state, bn_state, xl, yl):
         def loss_fn(p):
             logits, ns = _dp_apply(p, bn_state, xl, compute_dtype)

@@ -5,6 +5,7 @@ import importlib.util
 import os
 
 import numpy as np
+import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -26,6 +27,10 @@ def test_simple_distributed_example():
     assert np.isfinite(final) and final < 1.0
 
 
+@pytest.mark.slow   # ~60-100s each: the imagenet example trains a
+# real (tiny) model through the full main(argv) path — far beyond
+# the tier-1 time budget; the other example smoke tests keep the
+# entry-point surface covered there
 def test_imagenet_example_resume_roundtrip(tmp_path):
     ex = _load("examples/imagenet/main_amp.py", "ex_imagenet")
     ck = str(tmp_path / "rn.ckpt")
@@ -62,6 +67,10 @@ def test_bert_example_fast_attention():
     assert np.isfinite(loss)
 
 
+@pytest.mark.slow   # ~60-100s each: the imagenet example trains a
+# real (tiny) model through the full main(argv) path — far beyond
+# the tier-1 time budget; the other example smoke tests keep the
+# entry-point surface covered there
 def test_imagenet_example_native_loader(tmp_path):
     """--loader native drives the C++ prefetch engine end to end, both
     synthetic and memmapped-npy data."""
@@ -82,6 +91,10 @@ def test_imagenet_example_native_loader(tmp_path):
     assert speed >= 0
 
 
+@pytest.mark.slow   # ~60-100s each: the imagenet example trains a
+# real (tiny) model through the full main(argv) path — far beyond
+# the tier-1 time budget; the other example smoke tests keep the
+# entry-point surface covered there
 def test_imagenet_example_distributed():
     """--distributed + --sync-bn over the 8-device mesh (the DDP+SyncBN
     BASELINE config shape), with the native loader feeding it."""

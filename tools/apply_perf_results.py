@@ -13,8 +13,28 @@ and TPU-backed; absent keys leave the built-in defaults untouched):
 
   flash_block_q/k       <- flash_autotune.best (the swept fwd winner)
   flash_bwd_block_q/k   <- flash_bwd_autotune.best (the bwd kernels'
-                           own winner; _clamp_blocks consults it for
-                           bwd=True with fallback to the fwd keys)
+                           shared winner; the bwd chain is fully
+                           independent — bwd arg > bwd env pin >
+                           flash_bwd_block_q/k profile > 128x128
+                           built-in — it NEVER falls back to fwd keys)
+  flash_bwd_dq_block_q/k
+                        <- flash_bwd_autotune.best_dq (per-kernel sweep)
+  flash_bwd_dkv_block_q/k
+                        <- best_fused when the fuse decision picked the
+                           fused kernel (it runs on the dkv grid and
+                           reads these keys), else best_dkv — the keys
+                           always carry the config the selected strategy
+                           was actually measured at
+  flash_bwd_fuse        <- best fused-ladder time vs best dq + best dkv
+                           split total; False when the fused ladder has
+                           no measured row (a failed kernel must not be
+                           re-enabled by the runtime byte-cap heuristic)
+  flash_bwd_impl        <- the fair grads(q,k,v) A/B rows, both timing
+                           the full fwd+bwd exactly as shipped (Pallas
+                           forward either way; only the gradient route
+                           differs): pallas wins only when
+                           pallas_grads_qkv <= xla_grads_qkv; otherwise
+                           backward="auto" routes to XLA
   xent_auto_impl        <- xentropy_fwdbwd speedup (pallas vs xla)
   bert_attn_impl        <- attn_seq_sweep: mean fast-vs-default speedup
                            at seq >= 512 (the flagship's regime)
@@ -34,11 +54,34 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def _tuning_schema():
+    """The committed profile schema (apex_tpu/utils/tuning.py), loaded
+    file-based so the CLI never pays the jax import."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_apex_tpu_tuning",
+        os.path.join(REPO, "apex_tpu", "utils", "tuning.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cfg(best):
+    """Strictly-validated ``"QxK"`` config string -> (q, k) ints, else
+    None.  A non-config winner (``jax_ref_fwdbwd`` has a single 'x' in
+    'jax') must SKIP the key, not crash decide() with a ValueError from
+    int() — ADVICE r5 #3."""
+    if isinstance(best, str) and re.fullmatch(r"\d+x\d+", best):
+        return tuple(int(v) for v in best.split("x"))
+    return None
 
 
 def _load(path):
@@ -72,22 +115,75 @@ def decide(bench, kern):
 
     if kern_tpu:
         at = _tpu_kernel(kernels, "flash_autotune")
-        best = at.get("best") if at else None
-        if isinstance(best, str) and "x" in best:
-            bq, bk = (int(v) for v in best.split("x"))
-            prof["flash_block_q"] = bq
-            prof["flash_block_k"] = bk
-            rows.append(("flash blocks", f"{bq}x{bk}",
+        qk = _cfg(at.get("best")) if at else None
+        if qk:
+            prof["flash_block_q"], prof["flash_block_k"] = qk
+            rows.append(("flash blocks", f"{qk[0]}x{qk[1]}",
                          f"autotune sweep {at.get('sweep_ms')}"))
 
         bt = _tpu_kernel(kernels, "flash_bwd_autotune")
-        best = bt.get("best") if bt else None
-        if isinstance(best, str) and best.count("x") == 1:
-            bq, bk = (int(v) for v in best.split("x"))
-            prof["flash_bwd_block_q"] = bq
-            prof["flash_bwd_block_k"] = bk
-            rows.append(("flash bwd blocks", f"{bq}x{bk}",
-                         f"bwd sweep {bt.get('sweep_ms')}"))
+        if bt:
+            sweep = bt.get("sweep_ms") or {}
+            qk = _cfg(bt.get("best"))
+            if qk:
+                prof["flash_bwd_block_q"], prof["flash_bwd_block_k"] = qk
+                rows.append(("flash bwd blocks", f"{qk[0]}x{qk[1]}",
+                             "best split total over the shared ladder"))
+            def _ms(prefix):
+                vals = [t for c, t in sweep.items()
+                        if c.startswith(prefix)
+                        and isinstance(t, (int, float))]
+                return min(vals) if vals else None
+
+            fused, dq_ms, dkv_ms = _ms("fused_"), _ms("dq_"), _ms("dkv_")
+            fuse = None
+            if None not in (dq_ms, dkv_ms):
+                # fused must have a MEASURED win; a fused ladder that
+                # failed outright (fused is None) records False so the
+                # runtime byte-cap heuristic cannot re-enable a kernel
+                # that just failed on this chip
+                fuse = fused is not None and fused < dq_ms + dkv_ms
+                prof["flash_bwd_fuse"] = fuse
+                rows.append(("flash_bwd_fuse", str(fuse).lower(),
+                             f"fused {fused} ms vs split "
+                             f"{round(dq_ms + dkv_ms, 3)} ms"
+                             if fused is not None else
+                             f"no fused row measured; split "
+                             f"{round(dq_ms + dkv_ms, 3)} ms"))
+
+            qk = _cfg(bt.get("best_dq"))
+            if qk:
+                prof["flash_bwd_dq_block_q"] = qk[0]
+                prof["flash_bwd_dq_block_k"] = qk[1]
+                rows.append(("flash bwd dq blocks", f"{qk[0]}x{qk[1]}",
+                             "per-kernel sweep best_dq"))
+            # the dkv profile keys feed BOTH the split dkv kernel and the
+            # fused kernel (it runs on the dkv grid — _clamp_blocks'
+            # "fused" chain reads the dkv keys), so they must carry the
+            # config the selected strategy actually measured: best_fused
+            # when fuse wins, best_dkv otherwise.  Writing best_dkv with
+            # fuse=true would ship a fused config that was never timed.
+            kv_name = "best_fused" if fuse else "best_dkv"
+            qk = _cfg(bt.get(kv_name))
+            if qk:
+                prof["flash_bwd_dkv_block_q"] = qk[0]
+                prof["flash_bwd_dkv_block_k"] = qk[1]
+                rows.append(("flash bwd dkv blocks", f"{qk[0]}x{qk[1]}",
+                             f"per-kernel sweep {kv_name} (the strategy "
+                             f"the fuse decision selected)"))
+
+            p_ab = sweep.get("pallas_grads_qkv")
+            x_ab = sweep.get("xla_grads_qkv")
+            if isinstance(p_ab, (int, float)) \
+                    and isinstance(x_ab, (int, float)):
+                # the auto-fallback rule: the Pallas backward must WIN the
+                # fair grads(q,k,v) A/B or backward="auto" ships the
+                # measured XLA pair instead of a regression
+                prof["flash_bwd_impl"] = ("pallas" if p_ab <= x_ab
+                                          else "xla")
+                rows.append(("flash_bwd_impl", prof["flash_bwd_impl"],
+                             f"grads(q,k,v) A/B: pallas {p_ab} ms vs "
+                             f"xla {x_ab} ms"))
 
         xe = _tpu_kernel(kernels, "xentropy_fwdbwd") or _tpu_kernel(
             kernels, "xentropy_fwd")
@@ -185,6 +281,15 @@ def main(argv=None):
         return 1
     if args.dry_run:
         return 0
+
+    bad = _tuning_schema().schema_violations(prof)
+    if bad:
+        # the decision engine and the profile consumers have drifted
+        # apart; a key the consumers would silently ignore (or choke on)
+        # must never reach disk
+        print(f"[apply_perf] profile fails the committed schema: "
+              f"{'; '.join(bad)}", file=sys.stderr)
+        return 1
 
     prof["_provenance"] = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
