@@ -24,6 +24,7 @@ from . import mlp
 from . import models
 from . import contrib
 from . import pyprof
+from . import telemetry
 from . import interop
 from . import RNN
 from . import reparameterization

@@ -14,6 +14,7 @@ Scale-update policy matches ``scaler.py:206-226``: x2 after ``scale_window``
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -113,6 +114,50 @@ def update(state: ScalerState, finite) -> ScalerState:
     new_scale = jnp.where(finite, grown, halved)
     new_unskipped = jnp.where(finite & ~should_grow, grown_count, 0)
     return state._replace(loss_scale=new_scale, unskipped=new_unskipped)
+
+
+def transition_kind(prev_scale: float, new_scale: float,
+                    prev_unskipped: int, new_unskipped: int,
+                    scale_window: Optional[int] = None,
+                    min_loss_scale: Optional[float] = None,
+                    max_loss_scale: Optional[float] = None) -> str:
+    """Classify one ``update`` transition from host-read scalars — the
+    telemetry hook point for the scaler's halve/double/steady policy
+    (``update_scale``, scaler.py:206-226).
+
+    Returns ``"overflow"`` (scale halved, or pinned at min_loss_scale
+    with the unskipped streak reset), ``"grew"`` (doubled after
+    scale_window finite steps) or ``"steady"``.  Pure host math so
+    ``telemetry.events.observe_scaler`` can batch the device reads.
+
+    A scale-unchanged streak reset is ambiguous from the two scalars
+    alone: a halve clamped at min_loss_scale (overflow) or a double
+    clamped at max_loss_scale (finite, window reached).  The static
+    policy knobs disambiguate exactly — at the floor (and not also at
+    the ceiling) a finite window-reached step would have DOUBLED, so an
+    unchanged scale is always an overflow; at the ceiling it is the
+    clamped grow.  Without the bounds, ``scale_window`` alone decides
+    (the pre-bounds heuristic).  A SECOND consecutive overflow at the
+    floor changes nothing observable (scale pinned, streak already 0)
+    and reads as "steady" — scalar observation cannot see it.
+    """
+    if new_scale < prev_scale:
+        return "overflow"
+    if new_scale > prev_scale:
+        return "grew"
+    if new_unskipped == 0 and new_unskipped < prev_unskipped:
+        at_min = min_loss_scale is not None and prev_scale <= min_loss_scale
+        at_max = max_loss_scale is not None and prev_scale >= max_loss_scale
+        if at_min and not at_max:
+            return "overflow"       # halve clamped at the floor
+        # remaining reset causes: double clamped at the ceiling, or (with
+        # no bounds known) either clamp — the window decides: a reset at
+        # window-1 reads as the clamped grow, anything earlier can only
+        # be an overflow
+        if scale_window is not None and prev_unskipped + 1 >= scale_window:
+            return "steady"
+        return "overflow"
+    return "steady"
 
 
 def apply_if_finite(finite, new_tree, old_tree):

@@ -85,6 +85,18 @@ def native_available() -> bool:
     return _load() is not None
 
 
+def _record_loader(depth, wait_s) -> None:
+    """Telemetry loader meter (docs/telemetry.md): consumer wait per
+    batch + ring/queue depth after the dequeue.  A single attribute
+    check when no default registry is installed; import kept local so
+    the loader stays importable without the apex_tpu package root."""
+    try:
+        from ..telemetry import events as _tel_events
+    except ImportError:  # pragma: no cover - standalone module use
+        return
+    _tel_events.record_loader(depth, wait_s)
+
+
 def _put_checking_stop(q, item, stop) -> bool:
     """put() that wakes up to honor `stop` — a producer blocked on a full
     queue must not outlive an abandoned consumer (it would pin the data
@@ -196,9 +208,14 @@ class NativeLoader:
             xp = ctypes.c_void_p()
             yp = ctypes.c_void_p()
             tk = ctypes.c_int64()
+            import time as _time
             for _ in range(self.steps):
+                t0 = _time.perf_counter()
                 slot = lib.pf_acquire(h, ctypes.byref(xp), ctypes.byref(yp),
                                       ctypes.byref(tk))
+                # the C ring exposes no occupancy count: depth=None skips
+                # the gauge, the wait histogram still lands
+                _record_loader(None, _time.perf_counter() - t0)
                 if slot < 0:
                     break
                 n = int(np.prod(self._shape))
@@ -267,9 +284,13 @@ class NativeLoader:
         th = threading.Thread(target=producer, daemon=True)
         th.start()
         try:
+            import time as _time
+
             import jax
             while True:
+                t0 = _time.perf_counter()
                 item = q.get()
+                _record_loader(q.qsize(), _time.perf_counter() - t0)
                 if item is None:
                     return
                 if isinstance(item, BaseException):

@@ -31,6 +31,7 @@ have no SPMD meaning; XLA owns scheduling.
 """
 from __future__ import annotations
 
+import time
 import warnings
 from typing import Any, Callable, Optional
 
@@ -62,6 +63,17 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
     if not axis_is_bound(axis_name):
         return grads
     world = lax_axis_size(axis_name)
+    # telemetry collective meter (docs/telemetry.md): payload bytes and
+    # leaf count are static facts of the traced reduction — counted ONLY
+    # for leaves that actually psum (vma-pre-summed leaves emit no
+    # collective, so they must not inflate the byte meter future
+    # comms-perf decisions read).  The wall time is HOST time around
+    # building the reduction (trace/dispatch cost under jit — on-device
+    # collective time belongs to the profiler).  One attribute check
+    # when no registry is installed.
+    from ..telemetry import events as _tel_events
+    _meter = {"bytes": 0, "leaves": 0} if _tel_events.active() else None
+    _t0 = time.perf_counter() if _meter is not None else None
 
     pre = 1.0
     post = 1.0
@@ -90,12 +102,21 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
             return g.astype(orig_dtype)
         if pre != 1.0:
             g = g * pre
+        if _meter is not None:
+            # payload as reduced (post always_fp32 upcast): wire bytes
+            _meter["bytes"] += g.size * jnp.dtype(g.dtype).itemsize
+            _meter["leaves"] += 1
         g = jax.lax.psum(g, axis_name)
         if post != 1.0:
             g = g * post
         return g.astype(orig_dtype)
 
-    return jax.tree_util.tree_map(reduce_leaf, grads)
+    reduced = jax.tree_util.tree_map(reduce_leaf, grads)
+    if _meter is not None:
+        _tel_events.record_collective(axis_name, int(_meter["bytes"]),
+                                      _meter["leaves"],
+                                      time.perf_counter() - _t0)
+    return reduced
 
 
 class DistributedDataParallel:

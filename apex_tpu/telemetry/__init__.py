@@ -1,0 +1,39 @@
+"""apex_tpu.telemetry — training-telemetry subsystem.
+
+Four pieces (see docs/telemetry.md):
+
+  * :mod:`registry`  — counters/gauges/histograms/meters with a
+    host-sync-batching ``step()`` context, rank-0-gated JSONL emission
+    validated against the committed record :data:`SCHEMA`, and a true
+    no-op disabled mode;
+  * :mod:`events`    — structured events wired into the existing hook
+    points (amp scaler halve/double transitions, DDP collective meters,
+    loader queue gauges) through a process-default registry;
+  * :mod:`attrib`    — per-op FLOPs/bytes attribution over the compiled
+    HLO (the per-fusion refinement of ``pyprof.prof.cost_report``);
+  * :mod:`report`    — JSONL → step-metrics summary +
+    ``python -m apex_tpu.telemetry`` CLI.
+
+The reference has no counterpart: its observability is rank-0 prints
+and an ``AverageMeter`` whose docstring warns that printing costs an
+allreduce+sync (``examples/imagenet/main_amp.py:363-390``).  This
+subsystem is the registry that warning asks for, and the prerequisite
+for the comms-efficiency work (EQuARX-style quantized collectives,
+cross-replica sharding) that needs per-collective byte/step-time
+accounting before it can claim a win.
+"""
+from . import registry
+from . import events
+from .registry import (SCHEMA, Registry, Counter, Gauge, Histogram,
+                       AverageMeter, Throughput, JsonlSink, MemorySink,
+                       NULL_METRIC, record_violations, records_violations)
+from .events import (set_default, get_default, active, observe_scaler,
+                     observe_amp, record_collective, record_loader)
+
+__all__ = [
+    "registry", "events", "SCHEMA", "Registry", "Counter", "Gauge",
+    "Histogram", "AverageMeter", "Throughput", "JsonlSink", "MemorySink",
+    "NULL_METRIC", "record_violations", "records_violations",
+    "set_default", "get_default", "active", "observe_scaler",
+    "observe_amp", "record_collective", "record_loader",
+]

@@ -1,0 +1,7 @@
+"""``python -m apex_tpu.telemetry`` — render a run's JSONL (or run the
+instrumented-transformer demo) into the per-op FLOPs/bytes table and the
+step-metrics summary.  See ``report.main`` for the flags."""
+from .report import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

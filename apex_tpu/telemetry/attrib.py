@@ -1,0 +1,362 @@
+"""Per-op FLOPs/bytes cost attribution from the compiled HLO.
+
+``pyprof.prof.cost_report`` answers "what does the whole step cost"
+(one ``cost_analysis()`` over the optimized module).  This module is the
+per-op refinement VERDICT #9 asked for — the analog of the reference's
+``apex/pyprof/prof`` 25-module table (``blas.py``, ``conv.py``,
+``pointwise.py`` ... each hand-computing FLOPs/bytes per op class):
+
+  * the train step is compiled AOT (``jax.jit(fn).lower(...).compile()``,
+    never executed) and its *optimized* HLO text is walked instruction
+    by instruction — post-fusion, i.e. the ops that actually run;
+  * each entry-computation instruction gets a FLOP count from its
+    opcode class (dot/conv from contraction dims, reductions from input
+    size, elementwise/transcendental from output size; fusions sum
+    their fused computation) and a bytes estimate (operands + outputs —
+    the HBM traffic model: fusion intermediates stay on-chip);
+  * module totals from ``cost_analysis()`` ride alongside so the parsed
+    attribution can be sanity-checked against the compiler's own cost
+    model, and the roofline ceilings are shared with ``pyprof.prof``
+    (``HW_CEILINGS``) for per-op projected time and intensity.
+
+The result is a sorted table (``format_op_table``) approaching the
+reference's per-op breadth, rendered by ``python -m apex_tpu.telemetry``.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+_ITEMSIZE = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1,
+    "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+_TRANSCENDENTAL = frozenset((
+    "tanh", "exponential", "exp", "log", "logistic", "rsqrt", "sqrt",
+    "power", "sine", "cosine", "tan", "atan2", "erf", "expm1", "log1p",
+    "cbrt", "exponential-minus-one", "log-plus-one"))
+
+#: bookkeeping opcodes that move no data and do no math
+_SKIP = frozenset((
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "after-all", "partition-id", "replica-id", "opt-barrier"))
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s+(?:ROOT\s+)?%?(?P<var>[\w.\-]+)\s*=\s*(?P<type>\([^=]*?\)|\S+)\s+"
+    r"(?P<opcode>[\w\-]+)\((?P<rest>.*)$")
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s+\([^)]*\)\s*->")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+
+
+def _type_info(type_str: str):
+    """(total_elems, total_bytes) for an HLO type string — handles
+    tuples by summing their parts; token/opaque count 0."""
+    elems = 0
+    nbytes = 0
+    for m in _SHAPE_RE.finditer(type_str):
+        dt, dims = m.group(1), m.group(2)
+        size = _ITEMSIZE.get(dt)
+        if size is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        elems += n
+        nbytes += n * size
+    return elems, nbytes
+
+
+def _first_shape_dims(type_str: str) -> Optional[List[int]]:
+    m = _SHAPE_RE.search(type_str)
+    if not m:
+        return None
+    dims = m.group(2)
+    return [int(d) for d in dims.split(",")] if dims else []
+
+
+def _operand_types(rest: str) -> List[str]:
+    """Operand type strings from the text following the opening paren of
+    ``opcode(...)`` — every ``dtype[dims]`` before the attribute section
+    belongs to an operand reference."""
+    # operands end at the first top-level "), " — cheap approximation:
+    # shapes inside attributes (to_apply etc.) appear after "), " so
+    # cutting at the close paren that balances the open is enough
+    depth = 1
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                rest = rest[:i]
+                break
+    return [m.group(0) for m in _SHAPE_RE.finditer(rest)]
+
+
+def _dot_flops(out_elems: int, rest: str) -> Optional[float]:
+    """2 * out_elems * prod(lhs contracting dim sizes)."""
+    ops = _operand_types(rest)
+    m = _CONTRACT_RE.search(rest)
+    if not ops or m is None:
+        return None
+    lhs_dims = _first_shape_dims(ops[0])
+    if lhs_dims is None:
+        return None
+    k = 1
+    if m.group(1):
+        for d in m.group(1).split(","):
+            i = int(d)
+            if i < len(lhs_dims):
+                k *= lhs_dims[i]
+    return 2.0 * out_elems * k
+
+
+def _conv_flops(out_elems: int, rest: str) -> Optional[float]:
+    """2 * out_elems * (kernel elems / output feature count): the MAC
+    count each output element costs, independent of layout labels."""
+    ops = _operand_types(rest)
+    if len(ops) < 2:
+        return None
+    k_dims = _first_shape_dims(ops[1])
+    if not k_dims:
+        return None
+    m = re.search(r"dim_labels=\w+_(\w+)->", rest)
+    kernel_elems = 1
+    for d in k_dims:
+        kernel_elems *= d
+    out_feat = None
+    if m:
+        labels = m.group(1)
+        if "o" in labels and len(labels) == len(k_dims):
+            out_feat = k_dims[labels.index("o")]
+    if out_feat is None:
+        out_feat = k_dims[-1]
+    return 2.0 * out_elems * (kernel_elems / max(out_feat, 1))
+
+
+def _instr_flops(opcode: str, out_elems: int, rest: str,
+                 fused_flops: Dict[str, tuple]) -> tuple:
+    """(flops, transcendentals) for one instruction."""
+    if opcode == "dot":
+        f = _dot_flops(out_elems, rest)
+        return (f if f is not None else 2.0 * out_elems, 0.0)
+    if opcode == "convolution":
+        f = _conv_flops(out_elems, rest)
+        return (f if f is not None else 2.0 * out_elems, 0.0)
+    if opcode == "fusion":
+        m = _CALLS_RE.search(rest)
+        if m and m.group(1) in fused_flops:
+            return fused_flops[m.group(1)]
+        return (float(out_elems), 0.0)
+    if opcode in ("reduce", "reduce-window"):
+        ops = _operand_types(rest)
+        if ops:
+            e, _ = _type_info(ops[0])
+            return (float(e), 0.0)
+        return (float(out_elems), 0.0)
+    if opcode in _TRANSCENDENTAL:
+        return (float(out_elems), float(out_elems))
+    if opcode in ("copy", "transpose", "broadcast", "reshape", "slice",
+                  "concatenate", "pad", "reverse", "gather", "scatter",
+                  "dynamic-slice", "dynamic-update-slice", "iota",
+                  "convert", "all-gather", "all-reduce", "reduce-scatter",
+                  "collective-permute", "all-to-all", "select-and-scatter",
+                  "custom-call", "rng", "rng-bit-generator", "sort",
+                  "while", "conditional", "call"):
+        return (0.0, 0.0)
+    # default elementwise: one op per output element
+    return (float(out_elems), 0.0)
+
+
+def parse_hlo(text: str) -> List[dict]:
+    """Walk optimized HLO text and return one record per entry-computation
+    instruction (fusions carry their fused computation's FLOPs).
+
+    Record fields: ``op`` (HLO var), ``opcode``, ``jax_op`` (the
+    ``op_name`` metadata tail — the jax-level op that lowered here),
+    ``flops``, ``transcendentals``, ``bytes`` (operands + outputs),
+    ``out_bytes``.
+    """
+    computations: Dict[str, List[dict]] = {}
+    comp_order: List[str] = []
+    entry: Optional[str] = None
+    current: Optional[str] = None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        cm = _COMP_RE.match(line)
+        if cm and line.rstrip().endswith("{"):
+            current = cm.group("name")
+            computations[current] = []
+            comp_order.append(current)
+            if line.lstrip().startswith("ENTRY"):
+                entry = current
+            continue
+        if line.strip() == "}":
+            continue
+        if current is None:
+            continue
+        im = _INSTR_RE.match(line)
+        if im is None:
+            continue
+        opcode = im.group("opcode")
+        out_elems, out_bytes = _type_info(im.group("type"))
+        rest = im.group("rest")
+        op_bytes = sum(_type_info(t)[1] for t in _operand_types(rest))
+        nm = _OPNAME_RE.search(rest)
+        computations[current].append({
+            "op": im.group("var"), "opcode": opcode,
+            "jax_op": (nm.group(1).split("/")[-1] if nm else ""),
+            "out_elems": out_elems, "out_bytes": out_bytes,
+            "operand_bytes": op_bytes, "rest": rest,
+        })
+    if entry is None and comp_order:
+        entry = comp_order[-1]   # HLO text always ends with ENTRY
+
+    # FLOPs for fused computations first (fusions reference them)
+    fused_flops: Dict[str, tuple] = {}
+    for name, instrs in computations.items():
+        if name == entry:
+            continue
+        fl = tr = 0.0
+        for ins in instrs:
+            if ins["opcode"] in _SKIP:
+                continue
+            f, t = _instr_flops(ins["opcode"], ins["out_elems"],
+                                ins["rest"], fused_flops)
+            fl += f
+            tr += t
+        fused_flops[name] = (fl, tr)
+
+    rows: List[dict] = []
+    for ins in computations.get(entry, ()):
+        if ins["opcode"] in _SKIP:
+            continue
+        f, t = _instr_flops(ins["opcode"], ins["out_elems"], ins["rest"],
+                            fused_flops)
+        rows.append({
+            "op": ins["op"], "opcode": ins["opcode"],
+            "jax_op": ins["jax_op"], "flops": f, "transcendentals": t,
+            "bytes": float(ins["operand_bytes"] + ins["out_bytes"]),
+            "out_bytes": float(ins["out_bytes"]),
+        })
+    return rows
+
+
+def _compiled_text(compiled) -> str:
+    try:
+        return compiled.as_text()
+    except Exception:
+        # older jax: go through the runtime executable's HLO modules
+        return "\n".join(m.to_string() for m in
+                         compiled.runtime_executable().hlo_modules())
+
+
+def op_table(fn: Callable, *args, static_argnums=(), donate_argnums=(),
+             peak_flops: Optional[float] = None,
+             peak_bw: Optional[float] = None, **kwargs) -> dict:
+    """Compile ``fn(*args, **kwargs)`` AOT and return the per-op cost
+    attribution joined with the module-level ``cost_analysis()``.
+
+    Returns ``{platform, rows, by_opcode, total_flops, total_bytes,
+    module_flops, module_bytes, peak_flops, peak_bw}`` where each row
+    additionally carries ``intensity`` (FLOP/B), ``projected_us`` (the
+    per-op roofline lower bound) and ``pct_flops``/``pct_bytes`` shares.
+    """
+    import jax
+    from ..pyprof.prof import HW_CEILINGS, _first
+
+    jitted = jax.jit(fn, static_argnums=static_argnums,
+                     donate_argnums=donate_argnums)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    rows = parse_hlo(_compiled_text(compiled))
+
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:   # pragma: no cover - backend without cost model
+        cost = None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+
+    platform = jax.devices()[0].platform
+    ceil = HW_CEILINGS.get(platform, HW_CEILINGS["cpu"])
+    pf = peak_flops or ceil["peak_flops"]
+    pb = peak_bw or ceil["peak_bw"]
+
+    total_flops = sum(r["flops"] for r in rows)
+    total_bytes = sum(r["bytes"] for r in rows)
+    by_opcode: Dict[str, dict] = {}
+    for r in rows:
+        r["intensity"] = r["flops"] / r["bytes"] if r["bytes"] else 0.0
+        r["projected_us"] = 1e6 * max(r["flops"] / pf, r["bytes"] / pb)
+        r["pct_flops"] = 100.0 * r["flops"] / total_flops if total_flops \
+            else 0.0
+        r["pct_bytes"] = 100.0 * r["bytes"] / total_bytes if total_bytes \
+            else 0.0
+        agg = by_opcode.setdefault(
+            r["opcode"], {"count": 0, "flops": 0.0, "bytes": 0.0})
+        agg["count"] += 1
+        agg["flops"] += r["flops"]
+        agg["bytes"] += r["bytes"]
+    rows.sort(key=lambda r: (r["flops"], r["bytes"]), reverse=True)
+
+    return {
+        "platform": platform,
+        "rows": rows,
+        "by_opcode": by_opcode,
+        "total_flops": total_flops,
+        "total_bytes": total_bytes,
+        "module_flops": _first(cost, "flops"),
+        "module_bytes": _first(cost, "bytes accessed", "bytes_accessed"),
+        "peak_flops": pf,
+        "peak_bw": pb,
+    }
+
+
+def _human(n: float, unit: str = "") -> str:
+    from ..pyprof.prof import _human as h
+    return h(n, unit)
+
+
+def format_op_table(table: dict, top: int = 20) -> str:
+    """The reference ``prof/output.py`` table shape: one sorted row per
+    (post-fusion) op, FLOPs/bytes/intensity/roofline columns."""
+    rows = table["rows"]
+    shown = rows[:top]
+    lines = [
+        f"per-op cost attribution ({table['platform']}; "
+        f"{len(rows)} ops, top {len(shown)} by FLOPs)",
+        f"{'op':<34} {'opcode':<14} {'flops':>10} {'bytes':>10} "
+        f"{'FLOP/B':>8} {'proj us':>9} {'%flops':>7}",
+    ]
+    for r in shown:
+        name = r["jax_op"] or r["op"]
+        if len(name) > 33:
+            name = name[:30] + "..."
+        lines.append(
+            f"{name:<34} {r['opcode']:<14} "
+            f"{_human(r['flops']):>10} {_human(r['bytes']):>10} "
+            f"{r['intensity']:>8.1f} {r['projected_us']:>9.2f} "
+            f"{r['pct_flops']:>6.1f}%")
+    if len(rows) > top:
+        rest_f = sum(r["flops"] for r in rows[top:])
+        rest_b = sum(r["bytes"] for r in rows[top:])
+        lines.append(f"{'... ' + str(len(rows) - top) + ' more ops':<49} "
+                     f"{_human(rest_f):>10} {_human(rest_b):>10}")
+    lines.append(
+        f"parsed totals       {_human(table['total_flops'], 'FLOP')} / "
+        f"{_human(table['total_bytes'], 'B')}  (compiler cost model: "
+        f"{_human(table['module_flops'], 'FLOP')} / "
+        f"{_human(table['module_bytes'], 'B')})")
+    lines.append(
+        f"roofline ceilings   {_human(table['peak_flops'], 'FLOP/s')}, "
+        f"{_human(table['peak_bw'], 'B/s')}")
+    return "\n".join(lines)

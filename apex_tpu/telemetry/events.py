@@ -1,0 +1,133 @@
+"""Structured event stream wired into the existing hook points.
+
+Three producers feed the registry (ISSUE: amp scaler transitions, DDP
+collective meters, loader queue gauges):
+
+  * **amp scaler** — the scaler is pure pytree state updated *inside*
+    the jitted step, so transitions are observed host-side by comparing
+    the pre/post ``ScalerState`` (one batched ``device_get`` for the
+    scalars): :func:`observe_scaler` / :func:`observe_amp` classify
+    halve (overflow), double (scale_window growth) and steady steps via
+    ``amp.scaler.transition_kind`` and emit ``amp.overflow`` /
+    ``amp.loss_scale_doubled`` events plus the ``amp.loss_scale`` gauge.
+  * **DDP collectives** — ``parallel.distributed.allreduce_tree`` calls
+    :func:`record_collective` with the payload bytes, leaf count and
+    host wall time of each reduction it builds.  Under ``jit`` the call
+    fires at *trace* time (the collective itself fuses into the step, so
+    bytes/calls are per-traced-program facts and the wall time is
+    dispatch cost); in eager/shard_map-debug use it is per-call.  The
+    on-device collective time belongs to the profiler, not this meter —
+    documented in docs/telemetry.md.
+  * **data loader** — ``data.loader.NativeLoader`` reports the consumer
+    wait per batch and (python-ring path) the queue depth after each
+    dequeue via :func:`record_loader`.
+
+All hooks route through the process-default registry
+(:func:`apex_tpu.telemetry.set_default`); with none installed every hook
+is a single attribute check and an early return — instrumented library
+code stays free when telemetry is off.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from . import registry as _registry
+
+
+# -- default-registry plumbing (lives here so the hooks avoid importing
+#    the package __init__ back into themselves) -----------------------------
+
+_default: Optional[_registry.Registry] = None
+
+
+def set_default(reg: Optional[_registry.Registry]):
+    """Install ``reg`` as the process-default registry the library hooks
+    (DDP, loader) report into.  Pass None to uninstall.  Returns the
+    previous default so callers can restore it."""
+    global _default
+    prev = _default
+    _default = reg
+    return prev
+
+
+def get_default() -> Optional[_registry.Registry]:
+    return _default
+
+
+def active() -> bool:
+    """True when a default registry is installed and enabled — the fast
+    guard every library hook checks first."""
+    return _default is not None and _default.enabled
+
+
+# -- amp scaler transitions --------------------------------------------------
+
+def observe_scaler(reg, prev, new, *, loss_id: int = 0) -> Optional[str]:
+    """Classify one scaler update (host-side, after the jitted step) and
+    emit the matching event/metrics into ``reg``.
+
+    ``prev``/``new`` are the ``ScalerState`` before/after ``amp_step``
+    (or ``scaler.update``).  One batched ``device_get`` reads the four
+    scalars — gated on the registry being enabled, so an instrumented
+    loop with telemetry off pays NO host sync here (the subsystem's
+    disabled-mode contract).  Returns the transition kind ("overflow" |
+    "grew" | "steady"), or None when disabled (nothing was read).
+    """
+    if reg is None or not reg.enabled:
+        return None
+    import jax
+    from ..amp import scaler as _scaler
+    ps, ns, pu, nu = (float(v) for v in jax.device_get(
+        (prev.loss_scale, new.loss_scale, prev.unskipped, new.unskipped)))
+    kind = _scaler.transition_kind(ps, ns, pu, nu,
+                                   scale_window=prev.scale_window,
+                                   min_loss_scale=prev.min_loss_scale,
+                                   max_loss_scale=prev.max_loss_scale)
+    reg.gauge("amp.loss_scale").set(ns)
+    if kind == "overflow":
+        reg.counter("amp.overflow_steps").add(1)
+        reg.event("amp.overflow", loss_id=loss_id,
+                  old_scale=ps, new_scale=ns)
+    elif kind == "grew":
+        reg.event("amp.loss_scale_doubled", loss_id=loss_id,
+                  old_scale=ps, new_scale=ns, after_steps=int(pu) + 1)
+    return kind
+
+
+def observe_amp(reg, prev_state, new_state):
+    """Per-loss :func:`observe_scaler` over two ``AmpState`` bundles
+    (the host-side companion to the jitted ``amp.amp_step``).  Returns
+    the list of transition kinds, one per scaler."""
+    return [observe_scaler(reg, p, n, loss_id=i)
+            for i, (p, n) in enumerate(zip(prev_state.scalers,
+                                           new_state.scalers))]
+
+
+# -- library hooks (no-ops without a default registry) -----------------------
+
+def record_collective(axis_name: str, nbytes: int, n_leaves: int,
+                      seconds: float) -> None:
+    """DDP collective meter: bytes reduced + wall time per
+    ``allreduce_tree``/``Reducer.reduce`` call.  See module docstring
+    for the trace-time semantics under jit."""
+    if not active():
+        return
+    reg = _default
+    reg.counter("ddp.allreduce_calls").add(1)
+    reg.counter("ddp.allreduce_bytes").add(nbytes)
+    reg.counter("ddp.allreduce_leaves").add(n_leaves)
+    reg.histogram("ddp.allreduce_host_ms").observe(seconds * 1e3)
+    reg.event("ddp.allreduce", axis=axis_name, bytes=int(nbytes),
+              leaves=int(n_leaves), host_ms=seconds * 1e3)
+
+
+def record_loader(depth: Optional[int], wait_seconds: float) -> None:
+    """Loader meter: consumer wait per batch, ring/queue depth after the
+    dequeue (None when the native ring can't report it)."""
+    if not active():
+        return
+    reg = _default
+    reg.histogram("loader.wait_ms").observe(wait_seconds * 1e3)
+    if depth is not None:
+        reg.gauge("loader.queue_depth").set(depth)
+        reg.histogram("loader.depth_samples").observe(depth)

@@ -1,0 +1,573 @@
+"""Metrics registry: counters / gauges / histograms with rank-0-aware
+JSONL emission and host-sync batching.
+
+The reference's observability is an ``AverageMeter`` plus rank-0 prints,
+with a docstring warning that printing costs an allreduce+sync
+(``examples/imagenet/main_amp.py:363-390``).  This module is the
+registry that warning asks for:
+
+  * metric updates ACCEPT device arrays and store them unresolved — no
+    ``float()``, no ``.item()``, no implicit transfer at the call site;
+  * the :meth:`Registry.step` context batches all host reads into ONE
+    ``jax.block_until_ready`` + ONE ``jax.device_get`` per flush
+    interval (never inside the jitted step — the registry is host-side
+    code wrapped *around* the step call);
+  * disabled mode is a true no-op: updates hit a null metric object,
+    nothing is stored, and zero host syncs happen (asserted by
+    ``tests/L0/test_telemetry.py``);
+  * emission is rank-0 gated (``utils.logging.is_rank0``) and lands as
+    JSONL records validated against a committed :data:`SCHEMA` — the
+    same writer-validates posture as ``utils/tuning.SCHEMA``.
+
+No jax import at module scope: schema validation and the tooling that
+consumes telemetry artifacts (``tools/apply_perf_results.py``) must
+never pay backend bring-up.  jax is imported inside :meth:`Registry.flush`,
+the only place device values are resolved.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# record schema (the committed JSONL contract)
+# ---------------------------------------------------------------------------
+
+_is_str = lambda v: isinstance(v, str) and bool(v)
+_is_num = lambda v: isinstance(v, (int, float)) and not isinstance(v, bool)
+_is_int = lambda v: isinstance(v, int) and not isinstance(v, bool)
+_is_dict = lambda v: isinstance(v, dict)
+
+METRIC_TYPES = ("counter", "gauge", "meter", "histogram")
+
+#: Per-kind field predicates.  Each kind maps to (required, optional)
+#: field dicts; unknown fields are violations (a reader that would
+#: silently ignore them has drifted from the writer).
+SCHEMA = {
+    "meta": ({"kind": lambda v: v == "meta", "ts": _is_str,
+              "fields": _is_dict}, {"run": _is_str}),
+    "metric": ({"kind": lambda v: v == "metric", "ts": _is_str,
+                "step": _is_int, "name": _is_str,
+                "type": lambda v: v in METRIC_TYPES},
+               {"value": _is_num, "avg": _is_num, "stats": _is_dict,
+                "cum_count": _is_int}),
+    "event": ({"kind": lambda v: v == "event", "ts": _is_str,
+               "step": _is_int, "name": _is_str, "fields": _is_dict},
+              {}),
+}
+
+_HIST_STAT_KEYS = frozenset(("count", "sum", "min", "max", "mean"))
+
+
+def record_violations(rec: Any) -> List[str]:
+    """Schema complaints for one JSONL record (empty = valid)."""
+    if not isinstance(rec, dict):
+        return [f"record is not an object: {rec!r}"]
+    kind = rec.get("kind")
+    if kind not in SCHEMA:
+        return [f"unknown record kind {kind!r}"]
+    required, optional = SCHEMA[kind]
+    out = []
+    for k, pred in required.items():
+        if k not in rec:
+            out.append(f"{kind}: missing required field {k!r}")
+        elif not pred(rec[k]):
+            out.append(f"{kind}: bad value for {k!r}: {rec[k]!r}")
+    for k, v in rec.items():
+        if k in required:
+            continue
+        if k not in optional:
+            out.append(f"{kind}: unknown field {k!r}")
+        elif not optional[k](v):
+            out.append(f"{kind}: bad value for {k!r}: {v!r}")
+    if kind == "metric":
+        t = rec.get("type")
+        if t == "histogram":
+            stats = rec.get("stats")
+            if not isinstance(stats, dict):
+                out.append("metric: histogram record needs a stats dict")
+            else:
+                bad = set(stats) ^ _HIST_STAT_KEYS
+                if bad:
+                    out.append(f"metric: histogram stats keys off-schema: "
+                               f"{sorted(bad)}")
+                else:
+                    out.extend(f"metric: non-numeric stat {k!r}"
+                               for k, v in stats.items() if not _is_num(v))
+        elif t in ("counter", "gauge", "meter") and not _is_num(
+                rec.get("value")):
+            out.append(f"metric: {t} record needs a numeric value")
+    if kind == "event":
+        for k, v in (rec.get("fields") or {}).items():
+            if not (_is_num(v) or isinstance(v, (str, bool)) or v is None):
+                out.append(f"event: field {k!r} is not a scalar: {v!r}")
+    return out
+
+
+def records_violations(records) -> List[str]:
+    """Flatten :func:`record_violations` over a record list."""
+    out = []
+    for i, rec in enumerate(records):
+        out.extend(f"record[{i}]: {v}" for v in record_violations(rec))
+    return out
+
+
+def _ts() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+class JsonlSink:
+    """Append-only JSONL file sink.  Validates every record against
+    :data:`SCHEMA` before it touches disk (a writer emitting off-schema
+    records is a bug — fail the write, not the reader)."""
+
+    def __init__(self, path: str, validate: bool = True):
+        self.path = path
+        self.validate = validate
+        self._fh = None
+
+    def write(self, records) -> None:
+        if not records:
+            return
+        if self.validate:
+            bad = records_violations(records)
+            if bad:
+                raise ValueError("telemetry records fail the committed "
+                                 f"schema: {'; '.join(bad[:4])}")
+        if self._fh is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a")
+        for rec in records:
+            self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class MemorySink:
+    """In-memory record list — tests, and benches that embed telemetry
+    records into their JSON artifacts (``bench.py`` bert leg)."""
+
+    def __init__(self):
+        self.records: List[dict] = []
+
+    def write(self, records) -> None:
+        self.records.extend(records)
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+class _NullMetric:
+    """The disabled-mode target: every update is a bound no-op — no
+    storage, no host sync, nothing to flush.  Mirrors the full update
+    AND read surface of every metric class (same defaults), so code
+    written against an enabled registry runs unchanged when telemetry
+    is switched off."""
+
+    __slots__ = ()
+
+    name = ""
+    total = 0.0
+    value = None
+    val = sum = count = 0.0
+    avg = 0.0
+    cum_count = 0
+
+    def add(self, v=1, n=1):
+        pass
+
+    def set(self, v):
+        pass
+
+    def observe(self, v):
+        pass
+
+    def update(self, v, n=1):
+        pass
+
+    def reset(self):
+        pass
+
+    def __str__(self):
+        return "<telemetry disabled>"
+
+
+NULL_METRIC = _NullMetric()
+
+
+class Counter:
+    """Monotonic counter.  ``add`` accepts python numbers or device
+    arrays; arrays stay unresolved until the owning registry flushes."""
+
+    kind = "counter"
+
+    def __init__(self, name: str):
+        self.name = name
+        self.total = 0.0
+        self._pending: list = []
+
+    def add(self, v=1, n=1):
+        if n != 1:
+            self._pending.append((v, n))
+        else:
+            self._pending.append(v)
+
+    def _pending_values(self):
+        for item in self._pending:
+            yield item[0] if isinstance(item, tuple) else item
+
+    def _resolve(self, resolve):
+        for item in self._pending:
+            if isinstance(item, tuple):
+                v, n = item
+                self.total += resolve(v) * n
+            else:
+                self.total += resolve(item)
+        self._pending.clear()
+
+    def _record(self, step):
+        return {"kind": "metric", "ts": _ts(), "step": step,
+                "name": self.name, "type": "counter",
+                "value": float(self.total)}
+
+
+class Gauge:
+    """Last-value gauge (loader queue depth, current loss scale, ...)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[float] = None
+        self._pending = None
+        self._has_pending = False
+
+    def set(self, v):
+        self._pending = v
+        self._has_pending = True
+
+    def _pending_values(self):
+        if self._has_pending:
+            yield self._pending
+
+    def _resolve(self, resolve):
+        if self._has_pending:
+            self.value = resolve(self._pending)
+            self._pending = None
+            self._has_pending = False
+
+    def _record(self, step):
+        if self.value is None:
+            return None
+        return {"kind": "metric", "ts": _ts(), "step": step,
+                "name": self.name, "type": "gauge",
+                "value": float(self.value)}
+
+
+class Histogram:
+    """Windowed distribution: each flush emits count/sum/min/max/mean
+    over the observations since the previous flush, plus the cumulative
+    count — per-interval step-time stats stay meaningful while the total
+    sample count survives for rates."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str):
+        self.name = name
+        self.cum_count = 0
+        self._pending: list = []
+        self._window: list = []
+
+    def observe(self, v):
+        self._pending.append(v)
+
+    def _pending_values(self):
+        return iter(self._pending)
+
+    def _resolve(self, resolve):
+        for v in self._pending:
+            self._window.append(resolve(v))
+        self._pending.clear()
+
+    def _record(self, step):
+        if not self._window:
+            return None
+        w = self._window
+        self.cum_count += len(w)
+        rec = {"kind": "metric", "ts": _ts(), "step": step,
+               "name": self.name, "type": "histogram",
+               "stats": {"count": len(w), "sum": float(sum(w)),
+                         "min": float(min(w)), "max": float(max(w)),
+                         "mean": float(sum(w) / len(w))},
+               "cum_count": self.cum_count}
+        self._window = []
+        return rec
+
+
+class AverageMeter:
+    """Running value/average (the reference ``AverageMeter``,
+    ``examples/imagenet/main_amp.py:363``).  Standalone it behaves
+    exactly like the old ``utils.logging`` copy; constructed through
+    :meth:`Registry.meter` it also emits a ``meter`` record (value +
+    running avg) on every registry flush — the "meters move behind the
+    registry" step of the telemetry redesign."""
+
+    kind = "meter"
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.val = self.sum = self.count = 0.0
+
+    def update(self, val, n=1):
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+
+    @property
+    def avg(self):
+        return self.sum / max(self.count, 1)
+
+    def __str__(self):
+        return f"{self.name} {self.val:.4f} ({self.avg:.4f})"
+
+    # registry protocol (meters resolve eagerly: update() already takes
+    # a float — the caller opted into the sync, as the reference notes)
+    def _pending_values(self):
+        return iter(())
+
+    def _resolve(self, resolve):
+        pass
+
+    def _record(self, step):
+        if not self.count:
+            return None
+        return {"kind": "metric", "ts": _ts(), "step": step,
+                "name": self.name, "type": "meter",
+                "value": float(self.val), "avg": float(self.avg)}
+
+
+class Throughput:
+    """items/sec between ``tick()`` calls — the Speed print helper.  The
+    host sync needed for honest timing is the CALLER's float() readback
+    (the reference's 'printing costs a sync' note applies unchanged)."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.meter = AverageMeter("items/s")
+
+    def tick(self, n_items: int) -> float:
+        now = time.perf_counter()
+        rate = n_items / max(now - self.t0, 1e-9)
+        self.meter.update(rate)
+        self.t0 = now
+        return rate
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def _env_enabled() -> bool:
+    return os.environ.get("APEX_TPU_TELEMETRY", "1").lower() not in (
+        "0", "off", "false", "no")
+
+
+class Registry:
+    """Host-side metric registry wrapped around a (jitted) train step.
+
+    Usage::
+
+        reg = telemetry.Registry(sink=telemetry.JsonlSink("run.jsonl"),
+                                 flush_interval=10)
+        for batch in loader:
+            with reg.step():
+                state, loss = train_step(state, batch)   # jitted, async
+                reg.gauge("loss").set(loss)              # stays on device
+                reg.counter("examples").add(batch_size)
+        reg.flush()
+
+    ``loss`` above is a device array: nothing syncs until the flush
+    interval is reached, then ONE ``block_until_ready`` + ONE batched
+    ``device_get`` resolves every pending value.  ``flush_interval=0``
+    means manual flushing only.
+
+    ``enabled=False`` (or ``APEX_TPU_TELEMETRY=0``) turns every metric
+    accessor into :data:`NULL_METRIC` and :meth:`step` into a bare
+    yield — a true no-op with zero host syncs and no sink writes.
+    """
+
+    def __init__(self, *, sink=None, enabled: Optional[bool] = None,
+                 flush_interval: int = 1, rank0_only: bool = True,
+                 run_id: Optional[str] = None):
+        self.enabled = _env_enabled() if enabled is None else bool(enabled)
+        self.sink = sink
+        self.flush_interval = int(flush_interval)
+        self.rank0_only = rank0_only
+        self.run_id = run_id
+        self._metrics: Dict[str, Any] = {}
+        self._events: List[dict] = []
+        self._step = 0
+        self._wrote_meta = False
+
+    # -- metric accessors ---------------------------------------------------
+    def _get(self, name: str, cls):
+        if not self.enabled:
+            return NULL_METRIC
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name)
+        elif not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def meter(self, name: str) -> AverageMeter:
+        return self._get(name, AverageMeter)
+
+    # -- events -------------------------------------------------------------
+    def event(self, name: str, **fields) -> None:
+        """Buffer a structured event (written at the next flush).  Field
+        values must be scalars/strings; device scalars are resolved at
+        flush with the batched read."""
+        if not self.enabled:
+            return
+        self._events.append({"kind": "event", "ts": _ts(),
+                             "step": self._step, "name": name,
+                             "fields": fields})
+
+    # -- the step context ---------------------------------------------------
+    @contextlib.contextmanager
+    def step(self):
+        """Time one training step and auto-flush every
+        ``flush_interval`` steps.  Disabled mode: a bare yield — no
+        timing, no counters, no syncs."""
+        if not self.enabled:
+            yield self
+            return
+        self._step += 1
+        t0 = time.perf_counter()
+        yield self
+        self.histogram("step_time_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        if self.flush_interval and self._step % self.flush_interval == 0:
+            self.flush()
+
+    @property
+    def current_step(self) -> int:
+        return self._step
+
+    # -- flush --------------------------------------------------------------
+    def _resolver(self):
+        """One batched host read for every pending device value; python
+        numbers pass through untouched.  This is the registry's single
+        sync point (never inside the jitted step)."""
+        arrays = []
+        for m in self._metrics.values():
+            for v in m._pending_values():
+                if hasattr(v, "dtype"):
+                    arrays.append(v)
+        for ev in self._events:
+            for v in ev["fields"].values():
+                if hasattr(v, "dtype"):
+                    arrays.append(v)
+        resolved: Dict[int, float] = {}
+        if arrays:
+            import jax
+            jax.block_until_ready(arrays)
+            for a, host in zip(arrays, jax.device_get(arrays)):
+                resolved[id(a)] = float(host)
+
+        def resolve(v):
+            if hasattr(v, "dtype"):
+                return resolved.get(id(v), 0.0)
+            return float(v)
+
+        return resolve
+
+    def _emit_allowed(self) -> bool:
+        if not self.rank0_only:
+            return True
+        from ..utils.logging import is_rank0
+        return is_rank0()
+
+    def flush(self) -> List[dict]:
+        """Resolve pending values (one batched read), build records, and
+        write them to the sink (rank-0 gated).  Returns the records so
+        in-process consumers (benches) can embed them."""
+        if not self.enabled:
+            return []
+        resolve = self._resolver()
+        records: List[dict] = []
+        if not self._wrote_meta:
+            self._wrote_meta = True
+            meta = {"kind": "meta", "ts": _ts(),
+                    "fields": {"schema": 1}}
+            if self.run_id:
+                meta["run"] = self.run_id
+            records.append(meta)
+        for m in self._metrics.values():
+            m._resolve(resolve)
+            rec = m._record(self._step)
+            if rec is not None:
+                records.append(rec)
+        for ev in self._events:
+            ev["fields"] = {k: (resolve(v) if hasattr(v, "dtype") else v)
+                            for k, v in ev["fields"].items()}
+            records.append(ev)
+        self._events = []
+        if self.sink is not None and records and self._emit_allowed():
+            self.sink.write(records)
+        return records
+
+    def close(self) -> None:
+        self.flush()
+        if self.sink is not None:
+            self.sink.close()
+
+    # -- introspection ------------------------------------------------------
+    def read(self) -> Dict[str, Any]:
+        """Current aggregate per metric (resolves pending values)."""
+        if not self.enabled:
+            return {}
+        resolve = self._resolver()
+        out = {}
+        for name, m in self._metrics.items():
+            m._resolve(resolve)
+            if isinstance(m, Counter):
+                out[name] = m.total
+            elif isinstance(m, Gauge):
+                out[name] = m.value
+            elif isinstance(m, AverageMeter):
+                out[name] = m.avg
+            elif isinstance(m, Histogram):
+                out[name] = {"window": list(m._window),
+                             "cum_count": m.cum_count}
+        return out

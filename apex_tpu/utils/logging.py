@@ -5,12 +5,13 @@ one-time warning latches (``apex/amp/_amp_state.py:38-50`` ``maybe_print``,
 ``scaler.py:43-45`` warned latches) plus the examples' ``AverageMeter`` with
 its "printing costs an allreduce+sync" batching note
 (``examples/imagenet/main_amp.py:363-390``).  Same scope here, as a small
-shared util instead of per-module copies.
+shared util instead of per-module copies.  The meters (``AverageMeter``,
+``Throughput``) now live behind the telemetry registry
+(``apex_tpu.telemetry.registry``) and are lazily re-exported below.
 """
 from __future__ import annotations
 
 import sys
-import time
 from typing import Optional
 
 import jax
@@ -46,41 +47,16 @@ def warn_once(key: str, msg: Optional[str] = None) -> bool:
     return True
 
 
-class AverageMeter:
-    """Running value/average (examples/imagenet/main_amp.py AverageMeter)."""
+# The meters moved behind the telemetry registry
+# (``apex_tpu.telemetry.registry``): ``Registry.meter(name)`` returns an
+# AverageMeter whose value/avg also land in the JSONL stream.  These
+# re-exports keep the historical ``utils.logging`` import path working
+# (PEP 562 lazy attribute so importing this module never pulls the
+# telemetry package in — and the circular utils.logging <-> telemetry
+# import is broken for free).
 
-    def __init__(self, name: str = ""):
-        self.name = name
-        self.reset()
-
-    def reset(self):
-        self.val = self.sum = self.count = 0.0
-
-    def update(self, val, n=1):
-        self.val = float(val)
-        self.sum += float(val) * n
-        self.count += n
-
-    @property
-    def avg(self):
-        return self.sum / max(self.count, 1)
-
-    def __str__(self):
-        return f"{self.name} {self.val:.4f} ({self.avg:.4f})"
-
-
-class Throughput:
-    """items/sec between ``tick()`` calls — the Speed print helper.  The
-    host sync needed for honest timing is the CALLER's float() readback
-    (the reference's 'printing costs a sync' note applies unchanged)."""
-
-    def __init__(self):
-        self.t0 = time.perf_counter()
-        self.meter = AverageMeter("items/s")
-
-    def tick(self, n_items: int) -> float:
-        now = time.perf_counter()
-        rate = n_items / max(now - self.t0, 1e-9)
-        self.meter.update(rate)
-        self.t0 = now
-        return rate
+def __getattr__(name):
+    if name in ("AverageMeter", "Throughput"):
+        from ..telemetry import registry as _tr
+        return getattr(_tr, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

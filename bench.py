@@ -158,6 +158,30 @@ def time_optax(make_params, grads, grad_dtype=None):
     return ms
 
 
+def telemetry_summary(step_ms_samples, counters=None):
+    """Schema-valid telemetry block for a bench leg: the leg's measured
+    step times flow through the REAL registry (so the records match the
+    committed ``telemetry.SCHEMA`` exactly — test_bench_legs asserts it)
+    and the rendered summary rides next to the raw records.
+
+    ``counters``: extra cumulative counters, e.g. {"examples": total}.
+    Returns ``{"records": [...], "summary": {...}}``.
+    """
+    from apex_tpu import telemetry
+    from apex_tpu.telemetry import report as _treport
+    sink = telemetry.MemorySink()
+    reg = telemetry.Registry(sink=sink, flush_interval=0, rank0_only=False,
+                             run_id="bench")
+    h = reg.histogram("step_time_ms")
+    for ms in step_ms_samples:
+        h.observe(float(ms))
+    for name, total in (counters or {}).items():
+        reg.counter(name).add(float(total))
+    reg.flush()
+    return {"records": sink.records,
+            "summary": _treport.summarize(sink.records)}
+
+
 # v5e single-chip roofline — single-sourced from the pyprof roofline
 from apex_tpu.pyprof.prof import HW_CEILINGS
 
@@ -469,6 +493,10 @@ def _bench_bert_e2e_at(on_tpu, cfg, batch, seq):
            "model": ("bert-large-24L-flash-remat" if on_tpu
                      else "bert-tiny-cpu"),
            "n_params": n_params}
+    # the leg embeds its step timing as schema-valid telemetry records
+    # (docs/telemetry.md): tpu_watch.sh / downstream tooling read one
+    # format whether the numbers came from a bench or a live run
+    out["telemetry"] = telemetry_summary([ms], counters={"examples": batch})
     # 6ND fwd+bwd, +2ND for the remat'd second forward (attention's
     # seq^2 term omitted — labelled analytic, a lower bound)
     tokens = batch * seq

@@ -230,6 +230,37 @@ def test_assemble_mixed_backends_tags_every_leg(tmp_path):
     assert out_k["kernels"]["xentropy_fwd"]["_backend"] == "cpu"
 
 
+def test_bench_telemetry_records_schema_checked(tmp_path):
+    """bench legs that embed telemetry records (bert_e2e does, via
+    bench.telemetry_summary) must carry records valid against the
+    committed telemetry SCHEMA, and the block must survive the
+    leg-flush/assemble recovery path intact (ISSUE 3 satellite)."""
+    import pytest as _pytest
+    from apex_tpu.telemetry import records_violations
+    bench = _load_bench()
+    tel = bench.telemetry_summary([12.5], counters={"examples": 8})
+    assert records_violations(tel["records"]) == []
+    assert tel["summary"]["step_time_ms"]["count"] == 1
+    assert tel["summary"]["step_time_ms"]["mean"] == _pytest.approx(12.5)
+    # examples / (step time): the ready-made items/sec the summary carries
+    assert tel["summary"]["items_per_sec"] == _pytest.approx(640.0)
+
+    d = str(tmp_path)
+    flush_leg(d, "bert_e2e", {"step_ms": 12.5, "telemetry": tel},
+              backend="tpu")
+    out = assemble(d, "bench")
+    embedded = out["detail"]["bert_e2e"]["telemetry"]
+    assert records_violations(embedded["records"]) == []
+    # and the apply_perf_results auditor sees a clean artifact
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "apply_perf_results", os.path.join(ROOT, "tools",
+                                           "apply_perf_results.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.telemetry_violations(out) == []
+
+
 # ---------------------------------------------------------------------------
 # run_bench integration: the flush sequence under a simulated mid-run wedge
 # ---------------------------------------------------------------------------

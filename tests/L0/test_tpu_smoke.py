@@ -1,0 +1,79 @@
+"""tools/tpu_smoke.py — the Mosaic first-contact smoke gate (VERDICT
+next-round #7) exercised on CPU: tiny shapes run every Pallas kernel in
+interpret mode, so the harness logic (check runner, JSON contract, exit
+codes, --only filter, failure propagation) is tier-1-tested without a
+chip.  tpu_watch.sh wires the tool as its stage 0 (test_tpu_watch.py
+covers the gating)."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_smoke():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_smoke", os.path.join(ROOT, "tools", "tpu_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_checks_pass_tiny_interpret_mode():
+    """Every kernel family compiles (interpret) and matches XLA at the
+    tiny shapes — the full check set, in-process."""
+    sm = _load_smoke()
+    out = sm.run_checks(tiny=True)
+    assert out["failed"] == {}, out["failed"]
+    assert set(out["passed"]) == set(sm.CHECKS)
+    for name, rec in out["passed"].items():
+        assert rec["rel_err"] <= rec["tol"], (name, rec)
+    assert out["backend"] == "cpu" and out["tiny"] is True
+
+
+def test_only_filter_and_failure_exit_codes(monkeypatch):
+    sm = _load_smoke()
+    out = sm.run_checks(tiny=True, only={"multi_tensor"})
+    assert set(out["passed"]) == {"multi_tensor"} and not out["failed"]
+
+    # a failing check flips the exit code and lands in `failed` with the
+    # reason, without aborting the remaining checks
+    def boom(tiny):
+        raise RuntimeError("Mosaic lowering exploded")
+    monkeypatch.setitem(sm.CHECKS, "multi_tensor", (boom, 1e-5))
+    rc = sm.main(["--tiny", "--only", "multi_tensor,mlp"])
+    assert rc == 1
+    out = sm.run_checks(tiny=True, only={"multi_tensor", "mlp"})
+    assert "Mosaic lowering exploded" in out["failed"]["multi_tensor"]
+    assert "mlp" in out["passed"]                # others still ran
+
+    # a tolerance miss is a failure too, reported as rel_err vs tol
+    monkeypatch.setitem(sm.CHECKS, "mlp", (lambda tiny: 1.0, 1e-4))
+    out = sm.run_checks(tiny=True, only={"mlp"})
+    assert "rel_err" in out["failed"]["mlp"]
+
+
+def test_cli_json_contract(tmp_path):
+    """The watcher consumes exactly one JSON line + the exit code."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_smoke.py"),
+         "--tiny", "--only", "multi_tensor"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    assert r.returncode == 0, r.stderr[-1500:]
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["smoke"] == "pallas_numerics"
+    assert payload["backend"] == "cpu"
+    assert "multi_tensor" in payload["passed"]
+
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_smoke.py"),
+         "--only", "no_such_check"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    assert r2.returncode == 2
+    payload2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert "unknown checks" in payload2["failed"]["cli"]

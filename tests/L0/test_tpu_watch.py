@@ -33,6 +33,7 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_KERN_TO": "30",
            "APEX_WATCH_TRAIN_TO": "30",
            "APEX_WATCH_TRAIN_CMD": "",
+           "APEX_WATCH_SMOKE_CMD": "echo smoke-ok",
            "APEX_WATCH_APPLY_CMD": "echo applied",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
@@ -191,6 +192,43 @@ def test_cpu_fallback_artifact_does_not_end_the_mission(tmp_path):
     assert r.returncode == 1                      # never completed
     assert "re-run failed; kept best artifact" in log
     assert not (tmp_path / "TUNNEL_LIVE").exists()
+
+
+def test_smoke_failure_resumes_probe_loop(tmp_path):
+    """Stage 0 (tpu_smoke): a window whose kernel smoke fails must not
+    burn capture time — the watcher logs it and goes back to probing;
+    no bench runs, no TUNNEL_LIVE."""
+    order = tmp_path / "order.log"
+    r, log = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_SMOKE_CMD": "echo smoke-broken; false",
+        "APEX_WATCH_BENCH_CMD": f"echo bench >> {order}; false",
+        "APEX_WATCH_KERN_CMD": f"echo kern >> {order}; false",
+    })
+    assert r.returncode == 1                      # gave up, never captured
+    assert "tpu_smoke FAILED" in log
+    assert log.count("tpu_smoke done rc=1") >= 5  # every window gated
+    assert not order.exists()                     # benches never started
+    assert not (tmp_path / "TUNNEL_LIVE").exists()
+
+
+def test_smoke_runs_first_then_stages_proceed(tmp_path):
+    """A passing smoke gates nothing: stage order is smoke -> kernels ->
+    (bench skipped when complete) -> train."""
+    (tmp_path / "BENCH_TPU_r5.json").write_text(COMPLETE_BENCH)
+    order = tmp_path / "order.log"
+    r, log = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_SMOKE_CMD": f"echo smoke >> {order}",
+        "APEX_WATCH_KERN_CMD":
+            f"echo kern >> {order}; echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_BENCH_CMD": f"echo bench >> {order}; false",
+        "APEX_WATCH_TRAIN_CMD": f"echo train >> {order}",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    assert order.read_text().split() == ["smoke", "kern", "train"]
+    assert "tpu_smoke done rc=0" in log
+    assert (tmp_path / "TUNNEL_LIVE").exists()
 
 
 def test_wedged_probe_keeps_probing_then_gives_up(tmp_path):

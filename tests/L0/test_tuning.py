@@ -433,6 +433,50 @@ def test_decide_failed_fused_ladder_records_fuse_false():
     assert prof["flash_bwd_dkv_block_q"] == 256   # split keys still land
 
 
+def _good_telemetry_block():
+    return {"records": [
+        {"kind": "metric", "ts": "2026-08-04T00:00:00Z", "step": 0,
+         "name": "step_time_ms", "type": "histogram",
+         "stats": {"count": 1, "sum": 5.0, "min": 5.0, "max": 5.0,
+                   "mean": 5.0}, "cum_count": 1}],
+        "summary": {"steps": 0}}
+
+
+def test_apply_perf_results_audits_embedded_telemetry(tmp_path, capsys):
+    """Bench artifacts embedding telemetry records are schema-checked by
+    the same tool that audits them for tuning decisions: valid blocks
+    pass silently, drifted records are surfaced as warnings without
+    blocking the (telemetry-independent) profile write."""
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    bench["detail"]["bert_e2e"] = {"step_ms": 5.0,
+                                   "telemetry": _good_telemetry_block()}
+    assert mod.telemetry_violations(bench) == []
+    assert mod.telemetry_violations(kern) == []
+
+    bench["detail"]["bert_e2e"]["telemetry"]["records"].append(
+        {"kind": "metric", "name": "x"})        # off-schema
+    bad = mod.telemetry_violations(bench)
+    assert bad and "bert_e2e" in bad[0]
+
+    # blocks nested under LIST-valued nodes are audited too
+    listed = {"detail": {"sweep": [
+        {"telemetry": {"records": [{"kind": "bogus"}], "summary": {}}}]}}
+    bad2 = mod.telemetry_violations(listed)
+    assert bad2 and "sweep[0]" in bad2[0]
+
+    bpath = tmp_path / "b.json"
+    bpath.write_text(json.dumps(bench))
+    kpath = tmp_path / "k.json"
+    kpath.write_text(json.dumps(kern))
+    out = tmp_path / "tuned.json"
+    rc = mod.main(["--bench", str(bpath), "--kernels", str(kpath),
+                   "--out", str(out)])
+    assert rc == 0                              # tuning write unaffected
+    assert out.exists()
+    assert "WARNING bench" in capsys.readouterr().err
+
+
 def test_schema_violations():
     """The committed profile schema: unknown keys and ill-typed values are
     violations; ``_``-prefixed metadata is exempt."""

@@ -74,6 +74,52 @@ def _tuning_schema():
     return mod
 
 
+def _telemetry_schema():
+    """The committed telemetry record schema
+    (apex_tpu/telemetry/registry.py), loaded file-based like
+    :func:`_tuning_schema` so the CLI never pays the jax import (the
+    registry module keeps jax out of module scope for exactly this)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_apex_tpu_telemetry_registry",
+        os.path.join(REPO, "apex_tpu", "telemetry", "registry.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def telemetry_violations(artifact) -> list:
+    """Schema complaints for every ``telemetry`` block embedded in a
+    bench artifact (``{"records": [...], "summary": {...}}`` blocks, as
+    ``bench.telemetry_summary`` writes them).  A bench leg that embeds
+    off-schema records has drifted from the committed contract —
+    surfaced as warnings here and asserted empty by test_tuning.py /
+    test_bench_legs.py."""
+    out = []
+    schema = None   # loaded once, and only if a telemetry block exists
+
+    def walk(node, path):
+        nonlocal schema
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+            return
+        if not isinstance(node, dict):
+            return
+        tel = node.get("telemetry")
+        if isinstance(tel, dict) and isinstance(tel.get("records"), list):
+            if schema is None:
+                schema = _telemetry_schema()
+            out.extend(f"{path}.telemetry: {v}" for v in
+                       schema.records_violations(tel["records"]))
+        for k, v in node.items():
+            if k != "telemetry":
+                walk(v, f"{path}.{k}")
+
+    walk(artifact if isinstance(artifact, dict) else {}, "artifact")
+    return out
+
+
 def _cfg(best):
     """Strictly-validated ``"QxK"`` config string -> (q, k) ints, else
     None.  A non-config winner (``jax_ref_fwdbwd`` has a single 'x' in
@@ -271,6 +317,12 @@ def main(argv=None):
         print("[apply_perf] no TPU-backed artifact found; refusing to write "
               "a tuning profile from CPU numbers", file=sys.stderr)
         return 1
+
+    # telemetry blocks don't feed tuning decisions, but drifted records
+    # must not pass silently through the one tool that audits artifacts
+    for label, art in (("bench", bench), ("kernels", kern)):
+        for v in telemetry_violations(art):
+            print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
 
     prof, rows = decide(bench, kern)
     table = render(rows)

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Mosaic first-contact smoke: compile every Pallas kernel family at one
+production shape and assert numerics against the XLA reference, in under
+60 s of chip time (VERDICT next-round #7).
+
+Run by ``tpu_watch.sh`` as the FIRST capture stage: a chip/toolchain
+combination that cannot compile-and-match the kernels is not worth
+burning a recovery window on — the watcher logs the failure and resumes
+probing.  On CPU the same checks run in Pallas interpret mode at small
+shapes (``--tiny``), so the harness logic has a tier-1 test without a
+chip (``tests/L0/test_tpu_smoke.py``).
+
+Always prints exactly ONE JSON line on stdout::
+
+    {"smoke": "pallas_numerics", "backend": "tpu", "tiny": false,
+     "elapsed_s": 41.3, "passed": {"flash_fwd": {...}, ...},
+     "failed": {"xentropy": "XlaRuntimeError(...)"}}
+
+exit 0 iff nothing failed.  ``--only a,b`` restricts the check set.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _rel_err(got, want):
+    import jax.numpy as jnp
+    got = jnp.asarray(got, jnp.float32)
+    want = jnp.asarray(want, jnp.float32)
+    denom = float(jnp.max(jnp.abs(want))) or 1.0
+    return float(jnp.max(jnp.abs(got - want))) / denom
+
+
+def _tree_rel_err(got, want):
+    import jax
+    return max(_rel_err(g, w) for g, w in
+               zip(jax.tree_util.tree_leaves(got),
+                   jax.tree_util.tree_leaves(want)))
+
+
+# ---------------------------------------------------------------------------
+# checks — each returns the max relative error of pallas vs XLA.  Shapes:
+# (production, tiny); production = the flagship regimes the benches run.
+# ---------------------------------------------------------------------------
+
+def check_flash_fwd(tiny):
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.contrib.multihead_attn import flash as F
+    BH, S, D = (2, 128, 64) if tiny else (64, 512, 64)
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (BH, S, D), jnp.bfloat16) * 0.1
+    k = jax.random.normal(k2, (BH, S, D), jnp.bfloat16) * 0.1
+    v = jax.random.normal(k3, (BH, S, D), jnp.bfloat16) * 0.1
+    bias = jnp.zeros((1, 1, S), jnp.float32)
+    got = jax.jit(lambda a, b, c: F.flash_attention(
+        a, b, c, bias, causal=True, heads=1))(q, k, v)
+    want = F._xla_reference(q, k, v, bias, True, 0.0, 0, 1)
+    return _rel_err(got, want)
+
+
+def check_flash_bwd(tiny):
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.contrib.multihead_attn import flash as F
+    BH, S, D = (2, 128, 64) if tiny else (64, 512, 64)
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(k1, (BH, S, D), jnp.bfloat16) * 0.1
+    k = jax.random.normal(k2, (BH, S, D), jnp.bfloat16) * 0.1
+    v = jax.random.normal(k3, (BH, S, D), jnp.bfloat16) * 0.1
+    bias = jnp.zeros((1, 1, S), jnp.float32)
+
+    def loss(backward):
+        return jax.jit(jax.grad(
+            lambda a, b, c: F.flash_attention(
+                a, b, c, bias, causal=True, heads=1,
+                backward=backward).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2)))
+    got = loss("pallas")(q, k, v)
+    want = loss("xla")(q, k, v)
+    return _tree_rel_err(got, want)
+
+
+def check_xentropy(tiny):
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.contrib.xentropy import softmax_xentropy_loss
+    N, H = (64, 512) if tiny else (2048, 8192)
+    logits = jax.random.normal(jax.random.PRNGKey(2), (N, H), jnp.float32)
+    labels = jax.random.randint(jax.random.PRNGKey(3), (N,), 0, H)
+
+    def run(impl):
+        f = lambda lg: softmax_xentropy_loss(lg, labels, smoothing=0.1,
+                                             impl=impl).sum()
+        return jax.jit(jax.value_and_grad(f))(logits)
+    (lp, gp), (lx, gx) = run("pallas"), run("xla")
+    return max(_rel_err(lp, lx), _rel_err(gp, gx))
+
+
+def check_layer_norm(tiny):
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.normalization import fused_layer_norm_affine
+    N, H = (64, 256) if tiny else (4096, 1024)
+    x = jax.random.normal(jax.random.PRNGKey(4), (N, H), jnp.float32)
+    w = jnp.ones((H,)) * 1.1
+    b = jnp.zeros((H,)) + 0.1
+
+    def run(use_pallas):
+        f = lambda x_, w_, b_: fused_layer_norm_affine(
+            x_, w_, b_, (H,), use_pallas=use_pallas).sum()
+        return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(x, w, b)
+    (lp, gp), (lx, gx) = run(True), run(False)
+    return max(_rel_err(lp, lx), _tree_rel_err(gp, gx))
+
+
+def check_mlp(tiny):
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.ops.fused_mlp import dense_act
+    M, K, N = (64, 128, 256) if tiny else (1024, 1024, 4096)
+    x = jax.random.normal(jax.random.PRNGKey(5), (M, K), jnp.float32) * 0.1
+    w = jax.random.normal(jax.random.PRNGKey(6), (K, N), jnp.float32) * 0.1
+    b = jnp.zeros((N,)) + 0.05
+    got = jax.jit(lambda a, c, d: dense_act(a, c, d, "relu"))(x, w, b)
+    want = jnp.maximum(x @ w + b, 0.0)
+    return _rel_err(got, want)
+
+
+def check_multi_tensor(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu.multi_tensor_apply import (multi_tensor_axpby,
+                                             multi_tensor_l2norm,
+                                             multi_tensor_scale)
+    total = 4096 if tiny else 4 * 1024 * 1024
+    rng = np.random.RandomState(0)
+    xs = jnp.asarray(rng.randn(total).astype(np.float32))
+    ys = jnp.asarray(rng.randn(total).astype(np.float32))
+    scaled, _flag = multi_tensor_scale(xs, 0.5)
+    axpby, _flag = multi_tensor_axpby(xs, ys, 2.0, -0.5)
+    errs = [
+        _rel_err(scaled, xs * 0.5),
+        _rel_err(axpby, 2.0 * xs - 0.5 * ys),
+        _rel_err(multi_tensor_l2norm(xs),
+                 jnp.sqrt(jnp.sum(xs.astype(jnp.float32) ** 2))),
+    ]
+    return max(errs)
+
+
+# check name -> (fn, relative-error tolerance).  bf16 kernels compare
+# bf16-vs-bf16 math but accumulate differently (blocked f32 partials vs
+# one einsum), hence the looser flash tolerances.
+CHECKS = {
+    "flash_fwd": (check_flash_fwd, 3e-2),
+    "flash_bwd": (check_flash_bwd, 5e-2),
+    "xentropy": (check_xentropy, 1e-4),
+    "layer_norm": (check_layer_norm, 1e-4),
+    "mlp": (check_mlp, 1e-4),
+    "multi_tensor": (check_multi_tensor, 1e-5),
+}
+
+
+def run_checks(tiny: bool = False, only=None) -> dict:
+    """Run the check set and return the result payload (no printing)."""
+    import jax
+    t_start = time.monotonic()
+    names = list(CHECKS) if not only else [n for n in CHECKS if n in only]
+    passed = {}
+    failed = {}
+    for name in names:
+        fn, tol = CHECKS[name]
+        t0 = time.monotonic()
+        try:
+            err = fn(tiny)
+            rec = {"rel_err": round(err, 6), "tol": tol,
+                   "s": round(time.monotonic() - t0, 2)}
+            if err <= tol:
+                passed[name] = rec
+            else:
+                failed[name] = f"rel_err {err:.3e} > tol {tol:.0e}"
+        except Exception as e:
+            failed[name] = repr(e)[:200]
+    return {
+        "smoke": "pallas_numerics",
+        "backend": jax.default_backend(),
+        "tiny": bool(tiny),
+        "elapsed_s": round(time.monotonic() - t_start, 2),
+        "passed": passed,
+        "failed": failed,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tiny", action="store_true",
+                    help="small shapes (CPU interpret-mode tier-1 test)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of checks")
+    args = ap.parse_args(argv)
+    only = set(args.only.split(",")) if args.only else None
+    if only is not None:
+        unknown = only - set(CHECKS)
+        if unknown:
+            print(json.dumps({"smoke": "pallas_numerics",
+                              "failed": {"cli": f"unknown checks "
+                                                f"{sorted(unknown)}"},
+                              "passed": {}}))
+            return 2
+    out = run_checks(tiny=args.tiny, only=only)
+    print(json.dumps(out))
+    return 0 if not out["failed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

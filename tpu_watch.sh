@@ -11,6 +11,9 @@
 # bench's probe finished.  Order stages by what is still unknown, and
 # put the all-or-nothing train run AFTER the incremental bench stages
 # so a hanging train can never starve them across short windows):
+#   0. tools/tpu_smoke.py — compile every Pallas kernel at a production
+#      shape + numerics vs XLA in <60 s; failure means the window is not
+#      worth spending (back to probing);
 #   1. bench_kernels.py — Mosaic first-contact A/B, flash autotune,
 #      attn seq sweep, VMEM-model probe: NOTHING of this has ever been
 #      captured on silicon (flushes legs incrementally);
@@ -52,6 +55,12 @@ BENCH_LEGS=${APEX_WATCH_BENCH_LEGS:-BENCH_LEGS_r5}
 KERN_LEGS=${APEX_WATCH_KERN_LEGS:-BENCH_KERNELS_LEGS_r5}
 PROBE_CMD=${APEX_WATCH_PROBE_CMD:-'timeout 65 python -c "from apex_tpu.utils.platform import probe_ambient_backend as p
 r = p(50); print(r.detail); raise SystemExit(0 if r else 1)"'}
+# stage 0: Mosaic first-contact smoke — compile every Pallas kernel at a
+# production shape and check numerics vs XLA (<60 s).  A window whose
+# smoke fails is not worth spending on captures: the kernels the benches
+# exercise don't even compile/match on this chip+toolchain.
+SMOKE_CMD=${APEX_WATCH_SMOKE_CMD:-"python tools/tpu_smoke.py"}
+SMOKE_TO=${APEX_WATCH_SMOKE_TO:-90}
 BENCH_CMD=${APEX_WATCH_BENCH_CMD:-"python bench.py --inner --legs-dir $BENCH_LEGS"}
 KERN_CMD=${APEX_WATCH_KERN_CMD:-"python bench_kernels.py --inner --legs-dir $KERN_LEGS"}
 ASSEMBLE_CMD=${APEX_WATCH_ASSEMBLE_CMD:-"python -m apex_tpu.utils.bench_legs"}
@@ -100,6 +109,17 @@ for i in $(seq 1 "$N_PROBES"); do
   rc=$?
   if [ $rc -eq 0 ]; then
     echo "$(date +%H:%M:%S) tunnel healthy — running capture stages (legs incremental)" >> "$LOG"
+    # ---- stage 0: Pallas kernel smoke (compile + numerics gate) ----
+    if [ -n "$SMOKE_CMD" ]; then
+      timeout -k 10 "$SMOKE_TO" bash -c "$SMOKE_CMD" >> "$LOG" 2>&1
+      rc0=$?
+      echo "$(date +%H:%M:%S) tpu_smoke done rc=$rc0" >> "$LOG"
+      if [ $rc0 -ne 0 ]; then
+        echo "$(date +%H:%M:%S) tpu_smoke FAILED; kernels unusable on this chip/toolchain — resuming probe loop" >> "$LOG"
+        sleep "$SLEEP"
+        continue
+      fi
+    fi
     # ---- stage 1: kernel bench (the only never-captured artifact) ----
     if complete "$KERN_JSON"; then
       echo "$(date +%H:%M:%S) bench_kernels.py already complete; skipping" >> "$LOG"
