@@ -25,6 +25,7 @@ from . import models
 from . import contrib
 from . import pyprof
 from . import telemetry
+from . import resilience
 from . import interop
 from . import RNN
 from . import reparameterization

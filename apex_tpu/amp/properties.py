@@ -29,7 +29,14 @@ _ALLOWED = {
 # flash-attention gradient route (contrib.multihead_attn.flash): "auto"
 # defers to env/tuning-profile resolution; "pallas"/"xla" force the path
 # process-wide via flash.set_default_backward (applied by initialize()).
-_FLASH_BACKWARDS = ("auto", "pallas", "xla")
+# flash.BACKWARD_IMPLS is the single source of truth for the valid
+# values; imported lazily so this module never pulls Pallas in at
+# import time.
+
+
+def _flash_backwards():
+    from ..contrib.multihead_attn.flash import BACKWARD_IMPLS
+    return BACKWARD_IMPLS
 
 
 class Properties:
@@ -86,10 +93,10 @@ class Properties:
             elif name == "flash_attn_backward":
                 if value is None:
                     value = "auto"
-                if value not in _FLASH_BACKWARDS:
+                if value not in _flash_backwards():
                     raise ValueError(
                         f"flash_attn_backward must be one of "
-                        f"{_FLASH_BACKWARDS}, got {value!r}")
+                        f"{_flash_backwards()}, got {value!r}")
                 self.options[name] = value
             else:
                 self.options[name] = value

@@ -160,6 +160,19 @@ def transition_kind(prev_scale: float, new_scale: float,
     return "steady"
 
 
+def floor_pinned(state: ScalerState, scale_value: float) -> bool:
+    """Escalation hook for the resilience guard (docs/resilience.md):
+    True when a *dynamic* scaler's resolved ``scale_value`` sits at its
+    floor.  At the floor, overflow halving can no longer respond to
+    non-finite grads — every step just skips — so consecutive pinned
+    checks mean the run needs intervention beyond the scaler's policy
+    (the guard rolls back to the last good checkpoint).  Pure host math
+    over an already-read scale so the guard's batched ``device_get``
+    stays its only per-check host sync (a static scaler has no floor
+    dynamics and never escalates here)."""
+    return bool(state.dynamic) and scale_value <= state.min_loss_scale
+
+
 def apply_if_finite(finite, new_tree, old_tree):
     """Skip-step: select the updated pytree only when grads were finite.
 

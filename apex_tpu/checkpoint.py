@@ -22,16 +22,55 @@ Precision portability: pass ``amp.AmpState.params_for_eval()`` (fp32 view)
 as the model entry to reproduce the reference's O2 state_dict hook
 (``_initialize.py:133-142``), or save ``model_params`` as-is for an exact
 resume.
+
+Hardening (SURVEY §5.4 failure posture, built on by
+``apex_tpu.resilience.ckpt``): every file :func:`save` writes is framed
+with a magic tag, payload length and CRC32, so :func:`load` can tell a
+truncated or bit-rotten checkpoint from a good one and raise a clear
+:class:`CheckpointError` instead of a bare ``UnpicklingError`` mid-resume.
+Legacy bare-pickle files (pre-framing) still load; any corruption in them
+surfaces as :class:`CheckpointError` too.  :func:`verify` is the cheap
+integrity probe (header + CRC, no unpickling) the resume protocol's
+``latest()`` scan uses to skip bad files.
 """
 from __future__ import annotations
 
 import os
 import pickle
+import struct
 import tempfile
+import zlib
 from typing import Any, Dict
 
 import jax
 import numpy as np
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file is unreadable: truncated, checksum-mismatched,
+    or not a checkpoint at all.  Resume code can catch this one type and
+    fall back to an older file (``resilience.ckpt.CheckpointManager``)."""
+
+
+_MAGIC = b"APEXCKPT1\x00"
+_HEADER = struct.Struct("<QI")          # payload length, CRC32
+_CHUNK = 1 << 20
+
+
+class _CrcWriter:
+    """File-object proxy that accumulates CRC32 + length while pickle
+    STREAMS to disk — no state-sized ``dumps`` copy in host RAM (the
+    states this frames are multi-GB at BERT-large scale)."""
+
+    def __init__(self, fh):
+        self._fh = fh
+        self.crc = 0
+        self.length = 0
+
+    def write(self, b):
+        self.crc = zlib.crc32(b, self.crc)
+        self.length += len(b)
+        return self._fh.write(b)
 
 
 def _to_host(tree):
@@ -44,14 +83,25 @@ def _to_host(tree):
 
 
 def save(path: str, **entries: Any) -> None:
-    """Atomically write ``entries`` (pytrees of arrays / picklable values)."""
+    """Atomically write ``entries`` (pytrees of arrays / picklable values).
+
+    The on-disk record is CRC-framed (``magic | length | crc32 | pickle``)
+    so :func:`load`/:func:`verify` detect truncation and corruption.
+    The pickle streams to disk through a CRC accumulator and the header
+    is patched in afterwards — peak host memory stays one payload, not
+    two."""
     payload = {k: _to_host(v) for k, v in entries.items()}
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt_tmp_")
     try:
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(_MAGIC + _HEADER.pack(0, 0))        # placeholder
+            w = _CrcWriter(f)
+            pickle.dump(payload, w, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            f.seek(len(_MAGIC))
+            f.write(_HEADER.pack(w.length, w.crc & 0xffffffff))
         os.replace(tmp, path)       # atomic on POSIX
     except BaseException:
         try:
@@ -61,10 +111,78 @@ def save(path: str, **entries: Any) -> None:
         raise
 
 
+def _crc_scan(f, path: str, length: int, crc: int) -> None:
+    """Chunked CRC pass over the payload region (no whole-file read);
+    raises on truncation / mismatch and seeks back to the payload
+    start so the caller can stream-unpickle."""
+    start = f.tell()
+    actual, n = 0, 0
+    while True:
+        chunk = f.read(_CHUNK)
+        if not chunk:
+            break
+        actual = zlib.crc32(chunk, actual)
+        n += len(chunk)
+    if n != length:
+        raise CheckpointError(
+            f"{path}: truncated checkpoint ({n} of {length} "
+            f"payload bytes — an interrupted or partial write)")
+    if actual & 0xffffffff != crc:
+        raise CheckpointError(f"{path}: checkpoint checksum mismatch "
+                              "(file corrupted on disk)")
+    f.seek(start)
+
+
+def _open_checked(f, path: str):
+    """Position ``f`` at the pickle stream after integrity checks.
+    Framed files get the CRC pass; legacy bare-pickle files rewind to
+    0; empty files raise."""
+    head = f.read(len(_MAGIC))
+    if head == _MAGIC:
+        hdr = f.read(_HEADER.size)
+        if len(hdr) < _HEADER.size:
+            raise CheckpointError(f"{path}: truncated checkpoint header")
+        length, crc = _HEADER.unpack(hdr)
+        _crc_scan(f, path, length, crc)
+        return f
+    if not head:
+        raise CheckpointError(f"{path}: empty checkpoint file")
+    f.seek(0)                        # legacy pre-framing bare pickle
+    return f
+
+
 def load(path: str) -> Dict[str, Any]:
-    """Read a checkpoint written by :func:`save` (numpy pytrees)."""
+    """Read a checkpoint written by :func:`save` (numpy pytrees).
+
+    Raises :class:`CheckpointError` for a truncated file, a checksum
+    mismatch, or garbage content (legacy files included) — never a bare
+    ``UnpicklingError`` mid-resume."""
     with open(path, "rb") as f:
-        return pickle.load(f)
+        src = _open_checked(f, path)
+        try:
+            return pickle.load(src)
+        except Exception as e:
+            raise CheckpointError(
+                f"{path}: checkpoint payload does not unpickle "
+                f"({type(e).__name__}: {e})") from e
+
+
+def verify(path: str) -> None:
+    """Cheap integrity check: header + CRC for framed files (no
+    unpickling), a full :func:`load` for legacy ones.  Raises
+    :class:`CheckpointError` (or ``OSError`` for an unreadable path) on
+    any problem — the probe ``resilience.ckpt``'s ``latest()`` runs
+    before trusting a manifest entry."""
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head == _MAGIC:
+            hdr = f.read(_HEADER.size)
+            if len(hdr) < _HEADER.size:
+                raise CheckpointError(f"{path}: truncated checkpoint header")
+            length, crc = _HEADER.unpack(hdr)
+            _crc_scan(f, path, length, crc)
+            return
+    load(path)
 
 
 def restore_like(template, host_tree):

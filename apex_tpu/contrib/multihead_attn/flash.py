@@ -770,7 +770,9 @@ def _resolve_fuse(fuse, BH, Sq, Sk, D, bk):
         return bool(fuse)
     env = os.environ.get("APEX_TPU_FLASH_BWD_FUSE")
     if env is not None:
-        return env.lower() not in ("0", "false", "")
+        # same disable vocabulary as telemetry's _env_enabled: 'off' and
+        # 'no' disable (they used to read as truthy — ROADMAP deferral b)
+        return env.lower() not in ("0", "off", "false", "no", "")
     from ...utils import tuning
     prof = tuning.get_on_tpu("flash_bwd_fuse", None)
     if prof is not None:

@@ -16,7 +16,8 @@ N+1..N+depth.
 Degrades to a Python-thread fallback when no C++ toolchain is available
 (same API, same ring/overlap structure, GIL-bound fills).
 """
-from .loader import ArraySource, NativeLoader, SyntheticSource, native_available
+from .loader import (ArraySource, LoaderStallError, NativeLoader,
+                     SyntheticSource, native_available)
 
-__all__ = ["ArraySource", "NativeLoader", "SyntheticSource",
-           "native_available"]
+__all__ = ["ArraySource", "LoaderStallError", "NativeLoader",
+           "SyntheticSource", "native_available"]

@@ -85,6 +85,26 @@ def native_available() -> bool:
     return _load() is not None
 
 
+class LoaderStallError(RuntimeError):
+    """The loader waited longer than ``wait_timeout`` for a batch — a
+    wedged/stalled input source (or an injected ``loader_stall`` fault).
+    Raised so the training driver (``resilience.TrainGuard`` or the
+    caller) can act instead of hanging silently."""
+
+
+def _fault_stall(step: int) -> float:
+    """Resilience fault-injection shim (``loader_stall`` kind): sleeps
+    and returns the injected stall seconds when a fault is scheduled at
+    this batch index.  One cheap plan probe per batch when no plan is
+    configured; import kept local so the loader stays importable
+    without the apex_tpu package root."""
+    try:
+        from ..resilience import faults as _faults
+    except ImportError:  # pragma: no cover - standalone module use
+        return 0.0
+    return _faults.maybe_stall(step)
+
+
 def _record_loader(depth, wait_s) -> None:
     """Telemetry loader meter (docs/telemetry.md): consumer wait per
     batch + ring/queue depth after the dequeue.  A single attribute
@@ -167,11 +187,17 @@ class NativeLoader:
     keeps one extra batch in flight).  threads: C++ fill workers.
     device_put: set False to receive numpy copies instead of device arrays
     (e.g. when the consumer shards the batch itself).
+    wait_timeout: seconds the consumer tolerates waiting for one batch
+    before raising :class:`LoaderStallError` (None = wait forever).  On
+    the python ring the wait itself is bounded; the native ring's
+    acquire is an uninterruptible C call, so detection there is post-hoc
+    (the stall is reported as soon as the wedged acquire returns).
     """
 
     def __init__(self, source, batch_size: int, steps: int, *,
                  depth: int = 3, threads: int = 2, seed: int = 0,
-                 device_put: bool = True):
+                 device_put: bool = True,
+                 wait_timeout: Optional[float] = None):
         self.source = source
         self.batch_size = int(batch_size)
         self.steps = int(steps)
@@ -179,6 +205,8 @@ class NativeLoader:
         self.threads = int(threads)
         self.seed = int(seed)
         self.device_put = device_put
+        self.wait_timeout = (None if wait_timeout is None
+                             else float(wait_timeout))
         self._shape = (self.batch_size,) + tuple(source.shape)
 
     # -- iteration ---------------------------------------------------------
@@ -209,15 +237,23 @@ class NativeLoader:
             yp = ctypes.c_void_p()
             tk = ctypes.c_int64()
             import time as _time
-            for _ in range(self.steps):
+            for step in range(self.steps):
                 t0 = _time.perf_counter()
+                _fault_stall(step)       # injected stall counts as wait
                 slot = lib.pf_acquire(h, ctypes.byref(xp), ctypes.byref(yp),
                                       ctypes.byref(tk))
+                wait = _time.perf_counter() - t0
                 # the C ring exposes no occupancy count: depth=None skips
                 # the gauge, the wait histogram still lands
-                _record_loader(None, _time.perf_counter() - t0)
+                _record_loader(None, wait)
                 if slot < 0:
                     break
+                if self.wait_timeout is not None and wait > self.wait_timeout:
+                    lib.pf_release(h, slot)
+                    raise LoaderStallError(
+                        f"native loader stalled {wait:.2f}s (> "
+                        f"wait_timeout={self.wait_timeout}s) acquiring "
+                        f"batch {step}")
                 n = int(np.prod(self._shape))
                 x = np.ctypeslib.as_array(
                     ctypes.cast(xp, ctypes.POINTER(ctypes.c_float)),
@@ -287,10 +323,31 @@ class NativeLoader:
             import time as _time
 
             import jax
+            step = 0
             while True:
                 t0 = _time.perf_counter()
-                item = q.get()
-                _record_loader(q.qsize(), _time.perf_counter() - t0)
+                _fault_stall(step)       # injected stall counts as wait
+                step += 1
+                try:
+                    budget = self.wait_timeout
+                    if budget is not None:
+                        budget = max(budget - (_time.perf_counter() - t0),
+                                     0.0)
+                    item = q.get(timeout=budget)
+                except _q.Empty:
+                    raise LoaderStallError(
+                        f"loader stalled: no batch within "
+                        f"{self.wait_timeout}s (batch {step - 1})") from None
+                wait = _time.perf_counter() - t0
+                _record_loader(q.qsize(), wait)
+                if self.wait_timeout is not None and wait > self.wait_timeout:
+                    # a batch that ARRIVED late (e.g. an injected stall
+                    # with a still-full ring) is the same wedge signal as
+                    # an empty queue — detect it post-hoc like the
+                    # native path does
+                    raise LoaderStallError(
+                        f"loader stalled {wait:.2f}s (> wait_timeout="
+                        f"{self.wait_timeout}s) on batch {step - 1}")
                 if item is None:
                     return
                 if isinstance(item, BaseException):

@@ -1,0 +1,40 @@
+"""apex_tpu.resilience — fault injection, hardened checkpoints, and a
+self-resuming training guard.
+
+The operational layer production SPMD stacks treat as a subsystem
+(SURVEY §5.3 failure detection/revert, §5.4 checkpoint/resume), built
+so every failure path runs deterministically in tier-1 on CPU:
+
+  * :mod:`~apex_tpu.resilience.faults` — seeded, scheduled fault
+    injection (NaN/Inf corruption, loader stalls, simulated SIGTERM
+    preemption, collective failures) via config or ``APEX_TPU_FAULTS``;
+  * :mod:`~apex_tpu.resilience.guard` — :class:`TrainGuard`, the step
+    driver: background-thread checkpoint cadence, SIGTERM →
+    snapshot-then-clean-exit, non-finite-streak / scaler-floor
+    escalation → rollback with a bounded retry budget, auto-resume,
+    telemetry events;
+  * :mod:`~apex_tpu.resilience.ckpt` — :class:`CheckpointManager`:
+    ``keep_last`` rotation + manifest resume protocol over the
+    CRC-framed ``apex_tpu.checkpoint`` records, skipping corrupt or
+    partial files.
+
+See ``docs/resilience.md`` for the guard lifecycle, the fault-spec
+grammar, and the resume protocol.
+"""
+from . import ckpt, faults, guard
+from .ckpt import MANIFEST, CheckpointManager
+from .faults import (CollectiveFault, FaultError, FaultPlan, FaultSpec,
+                     StallingIterator, active_plan, corrupt, install,
+                     maybe_stall, parse, wrap_collective)
+from .guard import GuardAbort, GuardConfig, GuardReport, TrainGuard
+from ..checkpoint import CheckpointError
+from ..data.loader import LoaderStallError
+
+__all__ = [
+    "ckpt", "faults", "guard",
+    "CheckpointManager", "MANIFEST", "CheckpointError",
+    "FaultPlan", "FaultSpec", "FaultError", "CollectiveFault",
+    "StallingIterator", "parse", "install", "active_plan", "corrupt",
+    "maybe_stall", "wrap_collective", "LoaderStallError",
+    "TrainGuard", "GuardConfig", "GuardReport", "GuardAbort",
+]

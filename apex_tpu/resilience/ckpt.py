@@ -1,0 +1,157 @@
+"""Hardened checkpoint rotation + manifest resume protocol.
+
+Builds the SURVEY §5.4 checkpoint/resume posture on top of
+``apex_tpu.checkpoint``'s CRC-framed atomic records:
+
+  * ``keep_last=N`` rotation — bounded disk, never deleting the file a
+    resume would need;
+  * a ``MANIFEST.json`` (atomic write) naming every live checkpoint and
+    its step, so resume is one read instead of a directory stat-scan;
+  * a :meth:`CheckpointManager.latest` / :meth:`~CheckpointManager.
+    load_latest` protocol that verifies candidates (CRC first, then a
+    full load) newest-first and SKIPS corrupt or partial files — a
+    checkpoint that died mid-write costs one rotation slot, not the run.
+
+The manager is what :class:`~apex_tpu.resilience.guard.TrainGuard`
+writes through (from its background writer thread — all mutating and
+scanning entry points take one lock), but it stands alone for scripts
+that want rotation without the guard::
+
+    mgr = CheckpointManager("ckpts", keep_last=3)
+    mgr.save(step, {"step": step, "model": params, "opt": opt_state})
+    ...
+    found = mgr.load_latest()          # -> (step, payload) or None
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import checkpoint as _ckpt
+from ..checkpoint import CheckpointError
+
+MANIFEST = "MANIFEST.json"
+
+
+class CheckpointManager:
+    """Rotating, manifest-tracked checkpoints in one directory."""
+
+    def __init__(self, directory: str, *, keep_last: int = 3,
+                 prefix: str = "ckpt"):
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        self.directory = os.path.abspath(directory)
+        self.keep_last = int(keep_last)
+        self.prefix = prefix
+        self._lock = threading.Lock()
+
+    # -- paths ---------------------------------------------------------------
+    def path_for(self, step: int) -> str:
+        return os.path.join(self.directory,
+                            f"{self.prefix}-{int(step):010d}.ckpt")
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST)
+
+    # -- manifest ------------------------------------------------------------
+    def _read_manifest(self) -> List[Dict[str, Any]]:
+        """Manifest rows (step/file/ts), oldest first.  A missing or
+        corrupt manifest degrades to a directory scan — the manifest is
+        an index, never the only copy of the truth."""
+        try:
+            with open(self._manifest_path()) as f:
+                doc = json.load(f)
+            rows = doc.get("checkpoints")
+            if isinstance(rows, list) and all(
+                    isinstance(r, dict) and isinstance(r.get("step"), int)
+                    and isinstance(r.get("file"), str) for r in rows):
+                return sorted(rows, key=lambda r: r["step"])
+        except (OSError, ValueError):
+            pass
+        return self._scan_rows()
+
+    def _scan_rows(self) -> List[Dict[str, Any]]:
+        rows = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return rows
+        head, tail = f"{self.prefix}-", ".ckpt"
+        for name in names:
+            if not (name.startswith(head) and name.endswith(tail)):
+                continue
+            digits = name[len(head):-len(tail)]
+            if digits.isdigit():
+                rows.append({"step": int(digits), "file": name})
+        return sorted(rows, key=lambda r: r["step"])
+
+    def _write_manifest(self, rows: List[Dict[str, Any]]) -> None:
+        doc = {"version": 1, "checkpoints": rows}
+        path = self._manifest_path()
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+
+    # -- save + rotation -----------------------------------------------------
+    def save(self, step: int, payload: Dict[str, Any]) -> str:
+        """Atomically write ``payload`` as the checkpoint for ``step``,
+        update the manifest, and rotate files beyond ``keep_last``
+        (oldest first).  Returns the checkpoint path."""
+        path = self.path_for(step)
+        with self._lock:
+            os.makedirs(self.directory, exist_ok=True)
+            _ckpt.save(path, **payload)
+            rows = [r for r in self._read_manifest()
+                    if r["step"] != int(step)]
+            rows.append({"step": int(step),
+                         "file": os.path.basename(path),
+                         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime())})
+            rows.sort(key=lambda r: r["step"])
+            while len(rows) > self.keep_last:
+                victim = rows.pop(0)
+                try:
+                    os.unlink(os.path.join(self.directory, victim["file"]))
+                except OSError:
+                    pass
+            self._write_manifest(rows)
+        return path
+
+    # -- resume protocol -----------------------------------------------------
+    def latest(self) -> Optional[Tuple[int, str]]:
+        """Newest (step, path) whose file passes :func:`checkpoint.verify`
+        — corrupt/partial/missing candidates are skipped, so a save that
+        died mid-write can never be selected for resume."""
+        with self._lock:
+            rows = self._read_manifest()
+        for row in reversed(rows):
+            path = os.path.join(self.directory, row["file"])
+            try:
+                _ckpt.verify(path)
+            except (CheckpointError, OSError):
+                continue
+            return int(row["step"]), path
+        return None
+
+    def load_latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """Load the newest readable checkpoint: (step, payload), or None
+        when no checkpoint survives verification.  A file that passes
+        the CRC probe but fails the full load (shouldn't happen, but
+        disks lie) is skipped like any other corrupt candidate."""
+        with self._lock:
+            rows = self._read_manifest()
+        for row in reversed(rows):
+            path = os.path.join(self.directory, row["file"])
+            try:
+                return int(row["step"]), _ckpt.load(path)
+            except (CheckpointError, OSError):
+                continue
+        return None
+
+    def all_steps(self) -> List[int]:
+        with self._lock:
+            return [r["step"] for r in self._read_manifest()]

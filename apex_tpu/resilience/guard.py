@@ -1,0 +1,476 @@
+"""``TrainGuard`` — a self-resuming driver around any jitted step fn.
+
+The repo's failure-handling fragments (amp skip-step, ZeRO
+select-revert, atomic ``checkpoint.save``) become one operational layer
+(SURVEY §5.3/§5.4): the guard owns the step loop and gives it
+
+  * **checkpoint cadence** — every ``save_every_steps`` steps and/or
+    ``save_every_seconds`` of wall clock, snapshots are taken at health-
+    checked boundaries and written by a background thread (the step loop
+    never blocks on disk);
+  * **preemption safety** — SIGTERM/SIGINT (real, or injected via a
+    ``preempt`` fault) become snapshot-then-clean-exit, so a tunnel flap
+    mid-run costs the steps since the last boundary, not the run;
+  * **auto-resume** — a new ``run()`` over the same checkpoint dir picks
+    up at the manifest's newest verified checkpoint (corrupt files are
+    skipped), bitwise-identically when the batch source is
+    step-addressable;
+  * **escalation → rollback** — a non-finite-loss streak or a dynamic
+    loss scale pinned at its floor (``amp.scaler.floor_pinned``) rolls
+    the state back to the last good checkpoint with a bounded retry
+    budget and exponential backoff;
+  * **telemetry** — ``fault_injected`` / ``rollback`` / ``resumed`` /
+    ``checkpoint_saved`` events through the PR-2 registry (the installed
+    process default, or one passed in).
+
+Step-fn contract: ``step_fn(state, batch) -> new_state`` or
+``(new_state, loss, *aux)``; ``state`` is any pytree — an ``AmpState``,
+a ``(amp_state, bn_state)`` carry, a plain dict.  The batch source is
+either a callable ``batches(step) -> batch`` (step-addressable: resume
+and rollback replay identical data — required for the bitwise-resume
+guarantee) or a plain iterator (resume starts it from its current
+position; rollback is impossible and aborts with a clear error).
+
+Host-sync budget: the guard batches ALL its host reads (pending losses
++ the loss scale) into one ``jax.device_get`` per ``check_every`` steps
+— the telemetry registry's batching discipline.  Snapshots add one
+batched device read at checkpoint cadence.  A **disabled** guard
+(``GuardConfig(enabled=False)`` or ``APEX_TPU_GUARD=0``) is a true
+no-op: it calls the step fn and nothing else — zero extra host syncs
+per step, no signal handlers, no threads, asserted by
+``tests/L0/test_resilience.py``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import signal
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from . import faults as _faults
+from .ckpt import CheckpointManager
+from ..checkpoint import CheckpointError
+
+
+class GuardAbort(RuntimeError):
+    """The guard cannot make progress: rollback budget exhausted, no
+    checkpoint to roll back to, or a rollback was needed on a
+    non-replayable (iterator) batch source."""
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("APEX_TPU_GUARD", "1").lower() not in (
+        "0", "off", "false", "no")
+
+
+@dataclasses.dataclass
+class GuardConfig:
+    """Policy knobs for :class:`TrainGuard`.
+
+    ``check_every`` is the health-check cadence (steps per batched host
+    read); checkpoint cadence is evaluated at those same boundaries so
+    every checkpoint is health-screened before it is written.
+    ``floor_patience`` counts consecutive *checks* (not steps) the
+    dynamic loss scale sits at its floor before escalating; 0 disables
+    that detector.  ``enabled=None`` reads ``APEX_TPU_GUARD`` (default
+    on)."""
+    ckpt_dir: Optional[str] = None
+    save_every_steps: int = 0
+    save_every_seconds: float = 0.0
+    keep_last: int = 3
+    check_every: int = 10
+    nonfinite_streak: int = 3
+    floor_patience: int = 0
+    max_retries: int = 3
+    backoff_seconds: float = 0.25
+    save_on_exit: bool = True
+    auto_resume: bool = True
+    enabled: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.enabled is None:
+            self.enabled = _env_enabled()
+        if self.check_every < 1:
+            raise ValueError("check_every must be >= 1")
+
+
+@dataclasses.dataclass
+class GuardReport:
+    """What a :meth:`TrainGuard.run` did.  ``status`` is ``"completed"``
+    (reached num_steps), ``"preempted"`` (SIGTERM/SIGINT/injected
+    preemption — state snapshotted, rerun resumes), or ``"disabled"``."""
+    status: str
+    final_step: int
+    resumed_from: Optional[int] = None
+    rollbacks: int = 0
+    faults_injected: int = 0
+    checkpoints: int = 0
+
+
+class _AsyncWriter:
+    """Background checkpoint writer: the main loop hands (step, host
+    payload) over a small bounded queue and keeps stepping while the
+    pickle+write happens off-thread.  A write failure is re-raised at
+    the next submit/drain — silently losing checkpoints would void the
+    resume guarantee."""
+
+    def __init__(self, manager: CheckpointManager):
+        self._manager = manager
+        self._q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="apex-tpu-ckpt-writer")
+        self._thread.start()
+        self.written = 0
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                step, payload = item
+                try:
+                    self._manager.save(step, payload)
+                    self.written += 1
+                except BaseException as e:
+                    self._exc = e
+            finally:
+                self._q.task_done()
+
+    def _check(self):
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def submit(self, step: int, payload) -> None:
+        self._check()
+        self._q.put((step, payload))
+
+    def drain(self) -> None:
+        """Block until every submitted checkpoint is on disk."""
+        self._q.join()
+        self._check()
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=60.0)
+
+
+def _find_scaler(state):
+    """Locate a ScalerState for the floor detector: ``state.scalers[0]``
+    on an AmpState, or on any element one level into a tuple/list/dict
+    carry.  Explicit ``scaler_fn`` overrides this probe."""
+    sc = getattr(state, "scalers", None)
+    if sc:
+        return sc[0]
+    children = (state if isinstance(state, (tuple, list))
+                else state.values() if isinstance(state, dict) else ())
+    for el in children:
+        sc = getattr(el, "scalers", None)
+        if sc:
+            return sc[0]
+    return None
+
+
+class TrainGuard:
+    """The step driver.  See the module docstring for the contract.
+
+    ``plan`` pins a :class:`~apex_tpu.resilience.faults.FaultPlan`
+    (default: the installed/env plan at each ``run``); ``registry`` pins
+    a telemetry registry (default: the process default at emit time);
+    ``scaler_fn(state) -> ScalerState`` overrides the auto-probe for the
+    floor detector; ``on_check(step, losses)`` is called with the
+    resolved loss window at every health check (the example loops' print
+    hook — the values are already host floats, printing costs nothing
+    extra)."""
+
+    def __init__(self, step_fn: Callable, config: GuardConfig, *,
+                 plan=None, registry=None, scaler_fn=None,
+                 on_check: Optional[Callable[[int, List[float]],
+                                             None]] = None):
+        self.step_fn = step_fn
+        self.cfg = config
+        self._plan = plan
+        self._registry = registry
+        self._scaler_fn = scaler_fn
+        self._on_check = on_check
+        self._stop = False
+        self.manager = (CheckpointManager(config.ckpt_dir,
+                                          keep_last=config.keep_last)
+                        if config.enabled and config.ckpt_dir else None)
+
+    # -- telemetry ----------------------------------------------------------
+    def _emit(self, name: str, **fields) -> None:
+        reg = self._registry
+        if reg is None:
+            from ..telemetry import events as _events
+            reg = _events.get_default()
+        if reg is None or not reg.enabled:
+            return
+        reg.event(name, **fields)
+
+    # -- state <-> host ------------------------------------------------------
+    def _snapshot(self, state, step: int) -> dict:
+        """Host payload for ``state``: the leaf list (one batched device
+        read), unflattened at restore against the live state's treedef —
+        static pytree metadata (Properties, optimizer objects) is never
+        pickled, so any AmpState snapshots cleanly."""
+        import jax
+        leaves = jax.tree_util.tree_leaves(state)
+        host = jax.device_get(leaves)
+        host = [np.asarray(x) if hasattr(x, "dtype") else x for x in host]
+        return {"step": int(step), "leaves": host}
+
+    def _restore(self, template, payload: dict):
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        saved = payload["leaves"]
+        if len(saved) != len(leaves):
+            raise CheckpointError(
+                f"checkpoint has {len(saved)} leaves but the live state "
+                f"has {len(leaves)} — the model/optimizer configuration "
+                "changed since the checkpoint was written")
+
+        from jax.sharding import NamedSharding
+
+        def put(t, h):
+            if not (hasattr(t, "dtype") and hasattr(t, "shape")):
+                return h
+            arr = np.asarray(h)
+            if tuple(arr.shape) != tuple(t.shape):
+                raise CheckpointError(
+                    f"checkpoint leaf shape {arr.shape} != live "
+                    f"{tuple(t.shape)}")
+            # keep an explicit mesh sharding; anything else is left to
+            # jit's automatic placement (checkpoint.restore_like's rule)
+            sh = getattr(t, "sharding", None)
+            if not isinstance(sh, NamedSharding):
+                sh = None
+            return jax.device_put(arr.astype(t.dtype), sh)
+        return jax.tree_util.tree_unflatten(
+            treedef, [put(t, h) for t, h in zip(leaves, saved)])
+
+    # -- signals -------------------------------------------------------------
+    def _install_handlers(self):
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        prev = {}
+
+        def handler(signum, frame):
+            self._stop = True
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(sig, handler)
+            except (ValueError, OSError):  # pragma: no cover - exotic host
+                pass
+        return prev
+
+    @staticmethod
+    def _restore_handlers(prev):
+        if not prev:
+            return
+        for sig, old in prev.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+
+    # -- the loop ------------------------------------------------------------
+    @staticmethod
+    def _splitter(state):
+        """Build the ``out -> (new_state, loss)`` splitter for THIS
+        state shape.  A tuple return is only (new_state, loss, *aux)
+        when it is NOT structurally the state itself — a step fn
+        returning a bare ``(amp_state, bn_state)`` carry must not have
+        its bn_state mistaken for a loss."""
+        import jax
+        if not isinstance(state, tuple):
+            def split(out) -> Tuple[Any, Optional[Any]]:
+                if isinstance(out, tuple) and len(out) >= 2:
+                    return out[0], out[1]
+                return out, None
+            return split
+        state_def = jax.tree_util.tree_structure(state)
+
+        def split(out) -> Tuple[Any, Optional[Any]]:
+            if isinstance(out, tuple) and len(out) >= 2 \
+                    and jax.tree_util.tree_structure(out) != state_def:
+                return out[0], out[1]
+            return out, None
+        return split
+
+    def run(self, state, batches, num_steps: int, *, start_step: int = 0):
+        """Drive ``num_steps`` steps (global indices ``start_step`` ..
+        ``num_steps - 1``) and return ``(final_state, GuardReport)``."""
+        cfg = self.cfg
+        seekable = callable(batches)
+        split = self._splitter(state)
+        if not cfg.enabled:
+            it = None if seekable else iter(batches)
+            for step in range(start_step, num_steps):
+                batch = batches(step) if seekable else next(it)
+                state, _ = split(self.step_fn(state, batch))
+            return state, GuardReport(status="disabled",
+                                      final_step=num_steps)
+
+        plan = self._plan if self._plan is not None else _faults.active_plan()
+        it = None if seekable else iter(batches)
+        report = GuardReport(status="completed", final_step=start_step)
+        mgr = self.manager
+        step = start_step
+
+        if mgr is not None and cfg.auto_resume:
+            found = mgr.load_latest()
+            if found is not None and found[0] > start_step:
+                ck_step, payload = found
+                state = self._restore(state, payload)
+                step = min(ck_step, num_steps)
+                report.resumed_from = ck_step
+                self._emit("resumed", step=ck_step)
+                if plan is not None:
+                    # faults scheduled before the resume point already
+                    # happened in the interrupted run; a re-armed env
+                    # plan must not re-fire them (a re-firing preempt
+                    # would wedge the run in a preempt/resume loop)
+                    plan.skip_until(step)
+
+        self._stop = False
+        prev_handlers = self._install_handlers()
+        writer = _AsyncWriter(mgr) if mgr is not None else None
+        pending: List[Tuple[int, Any]] = []   # (step, device loss)
+        since_check = 0    # steps since the last boundary — NOT len(pending):
+        # a loss-less step fn must still hit the checkpoint cadence
+        self._streak = 0
+        self._floor_checks = 0
+        last_saved = step
+        t_last_save = time.monotonic()
+        try:
+            if mgr is not None and step < num_steps:
+                # rollback anchor: escalation before the first cadence
+                # save must still have somewhere to go
+                mgr.save(step, self._snapshot(state, step))
+                report.checkpoints += 1
+            while step < num_steps:
+                if plan is not None and not self._stop \
+                        and plan.fire("preempt", step) is not None:
+                    report.faults_injected += 1
+                    self._emit("fault_injected", kind="preempt", step=step)
+                    signal.raise_signal(signal.SIGTERM)
+                if self._stop:
+                    break
+                batch = batches(step) if seekable else next(it)
+                if plan is not None:
+                    for kind in ("nan", "inf"):
+                        if plan.fire(kind, step) is not None:
+                            batch = _faults.corrupt(batch, kind)
+                            report.faults_injected += 1
+                            self._emit("fault_injected", kind=kind,
+                                       step=step)
+                state, loss = split(self.step_fn(state, batch))
+                if loss is not None:
+                    pending.append((step, loss))
+                step += 1
+                since_check += 1
+                if not (since_check >= cfg.check_every
+                        or step >= num_steps or self._stop):
+                    continue
+                healthy = self._health_check(state, pending)
+                pending.clear()             # window consumed either way
+                since_check = 0
+                if not healthy:
+                    if writer is not None:
+                        writer.drain()      # newest ckpt must be on disk
+                    state, step = self._rollback(state, report, seekable)
+                    last_saved = min(last_saved, step)
+                    continue
+                if mgr is not None and not self._stop:
+                    due = ((cfg.save_every_steps
+                            and step - last_saved >= cfg.save_every_steps)
+                           or (cfg.save_every_seconds
+                               and time.monotonic() - t_last_save
+                               >= cfg.save_every_seconds))
+                    if due and step < num_steps:
+                        writer.submit(step, self._snapshot(state, step))
+                        report.checkpoints += 1
+                        last_saved = step
+                        t_last_save = time.monotonic()
+            if mgr is not None and (self._stop or cfg.save_on_exit):
+                writer.drain()
+                mgr.save(step, self._snapshot(state, step))
+                report.checkpoints += 1
+            if self._stop:
+                report.status = "preempted"
+                self._emit("preempted", step=step)
+            report.final_step = step
+            if writer is not None:
+                writer.drain()
+            return state, report
+        finally:
+            if writer is not None:
+                writer.close()
+            self._restore_handlers(prev_handlers)
+
+    # -- health + rollback ---------------------------------------------------
+    def _health_check(self, state, pending) -> bool:
+        """ONE batched host read over the pending losses (+ loss scale);
+        update the non-finite streak and floor counters; True = keep
+        going, False = escalate to rollback."""
+        import jax
+        cfg = self.cfg
+        scaler = (self._scaler_fn(state) if self._scaler_fn is not None
+                  else _find_scaler(state))
+        arrays = [loss for _, loss in pending]
+        if scaler is not None and cfg.floor_patience:
+            arrays = arrays + [scaler.loss_scale]
+        if not arrays:
+            return True
+        host = jax.device_get(arrays)
+        losses = [float(v) for v in host[:len(pending)]]
+        for v in losses:
+            self._streak = 0 if np.isfinite(v) else self._streak + 1
+        if scaler is not None and cfg.floor_patience:
+            from ..amp import scaler as _scaler_mod
+            pinned = _scaler_mod.floor_pinned(scaler, float(host[-1]))
+            self._floor_checks = self._floor_checks + 1 if pinned else 0
+        if self._on_check is not None and pending:
+            self._on_check(pending[-1][0] + 1, losses)
+        escalate = (self._streak >= cfg.nonfinite_streak
+                    or (cfg.floor_patience
+                        and self._floor_checks >= cfg.floor_patience))
+        return not escalate
+
+    def _rollback(self, state, report: GuardReport, seekable: bool):
+        cfg = self.cfg
+        why = ("non-finite loss streak" if self._streak
+               >= cfg.nonfinite_streak else "loss scale pinned at floor")
+        if not seekable:
+            raise GuardAbort(
+                f"escalation ({why}) needs a rollback, but the batch "
+                "source is a plain iterator — pass a callable "
+                "batches(step) so rolled-back steps can be replayed")
+        if self.manager is None:
+            raise GuardAbort(f"escalation ({why}) with no ckpt_dir "
+                             "configured: nothing to roll back to")
+        report.rollbacks += 1
+        if report.rollbacks > cfg.max_retries:
+            raise GuardAbort(
+                f"rollback budget exhausted ({cfg.max_retries} retries) "
+                f"— still escalating on {why}")
+        found = self.manager.load_latest()
+        if found is None:
+            raise GuardAbort(f"escalation ({why}) but no readable "
+                             f"checkpoint under {self.manager.directory}")
+        ck_step, payload = found
+        state = self._restore(state, payload)
+        self._streak = 0
+        self._floor_checks = 0
+        self._emit("rollback", to_step=ck_step, attempt=report.rollbacks,
+                   reason=why)
+        time.sleep(cfg.backoff_seconds * (2 ** (report.rollbacks - 1)))
+        return state, ck_step

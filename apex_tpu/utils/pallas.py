@@ -20,6 +20,15 @@ def compiler_params(dimension_semantics):
     return cls(dimension_semantics=tuple(dimension_semantics))
 
 
+def has_vma() -> bool:
+    """True when this jax tracks varying-manual-axes (vma) typing
+    (``jax.lax.pvary``/``pcast`` exist).  The 0.4-era ``check_rep``
+    cannot infer replication of autodiff-psummed / allgathered outputs
+    under ``shard_map`` — callers (tests included) disable the check on
+    those jaxes and rely on vma typing elsewhere."""
+    return hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast")
+
+
 def _vma_of(a):
     try:
         return jax.typeof(a).vma

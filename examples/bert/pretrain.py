@@ -75,6 +75,14 @@ def parse_args(argv=None):
                         "for long sequences / deep stacks")
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--auto-resume", default=None, metavar="DIR",
+                   help="drive the standard path through apex_tpu."
+                        "resilience.TrainGuard: rotating checkpoints in "
+                        "DIR, SIGTERM -> snapshot + clean exit, resume "
+                        "from the newest checkpoint on restart (not "
+                        "supported with --zero)")
+    p.add_argument("--save-every", type=int, default=50,
+                   help="guard checkpoint cadence in steps (--auto-resume)")
     return p.parse_args(argv)
 
 
@@ -218,6 +226,47 @@ def main(argv=None):
 
     rng = np.random.RandomState(args.seed)
     losses, tput = AverageMeter("mlm_loss"), Throughput()
+
+    if args.auto_resume:
+        if args.zero:
+            raise SystemExit("--auto-resume drives the standard path only "
+                             "(the ZeRO holder carry is not a pure pytree)")
+        from apex_tpu.resilience import GuardConfig, TrainGuard
+
+        def batch_at(step_idx):
+            # per-step seeding: resume and rollback replay the exact
+            # batch for any global step (the sequential-rng path below
+            # cannot be re-entered mid-stream)
+            rs = np.random.RandomState(
+                (args.seed * 1000003 + step_idx) % (2 ** 31 - 1))
+            tokens, targets, weights = synthetic_mlm(
+                rs, args.batch_size, args.seq_len, cfg.vocab_size)
+            return {"tokens": tokens, "targets": targets,
+                    "weights": weights}
+
+        def on_check(step_idx, window):
+            losses.update(window[-1])
+            rate = tput.tick(len(window) * args.batch_size)
+            print(f"step {step_idx:4d}  {losses}  "
+                  f"{rate:.1f} sequences/sec", flush=True)
+
+        with use_mesh(mesh):
+            state, step = run_standard(args, cfg, mesh)
+            guard = TrainGuard(step, GuardConfig(
+                ckpt_dir=args.auto_resume,
+                save_every_steps=args.save_every,
+                check_every=max(1, args.print_freq),
+                floor_patience=3), on_check=on_check)
+            state, rep = guard.run(state, batch_at, args.steps)
+        if rep.resumed_from is not None:
+            print(f"=> guard resumed from step {rep.resumed_from}")
+        print(f"=> guard: {rep.status} at step {rep.final_step}/"
+              f"{args.steps}  (rollbacks {rep.rollbacks}, checkpoints "
+              f"{rep.checkpoints})", flush=True)
+        if rep.status != "completed":
+            raise SystemExit(3)
+        print(f"=> done: final loss {losses.val:.4f}")
+        return losses.val
 
     with use_mesh(mesh):
         state, step = (run_zero if args.zero else run_standard)(args, cfg,

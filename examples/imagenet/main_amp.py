@@ -82,6 +82,17 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--resume", default=None, help="checkpoint to resume from")
     p.add_argument("--save", default=None, help="checkpoint path to write")
+    p.add_argument("--auto-resume", action="store_true",
+                   help="drive training through apex_tpu.resilience."
+                        "TrainGuard: rotating checkpoints under --save "
+                        "(required, used as a directory), SIGTERM -> "
+                        "snapshot + clean exit, NaN-streak rollback, and "
+                        "resume from the newest checkpoint on restart — "
+                        "an interrupted run makes incremental progress "
+                        "instead of restarting from step 0.  Exits "
+                        "non-zero unless all steps completed.")
+    p.add_argument("--save-every", type=int, default=50,
+                   help="guard checkpoint cadence in steps (--auto-resume)")
     p.add_argument("--prof", action="store_true",
                    help="capture a profiler trace of steps 5-10 "
                         "(apex_tpu.pyprof)")
@@ -154,6 +165,20 @@ def synthetic_batches(batch, seed, steps):
         images = protos[labels] + 0.08 * rng.standard_normal(
             (batch, 224, 224, 3), dtype=np.float32)
         yield images, labels.astype(np.int32)
+
+
+def synthetic_batch_at(batch, seed, step):
+    """Step-addressable synthetic batch for the guard path (--auto-resume):
+    same prototype pool + noise model as :func:`synthetic_batches`, but
+    seeded per (seed, step) so resume and rollback replay the EXACT batch
+    for any global step — the property the bitwise-resume proof needs."""
+    protos = _syn_protos()
+    rng = np.random.Generator(np.random.PCG64(
+        np.random.SeedSequence([seed, step])))
+    labels = rng.integers(0, _SYN_CLASSES, size=(batch,))
+    images = protos[labels] + 0.08 * rng.standard_normal(
+        (batch, 224, 224, 3), dtype=np.float32)
+    return images, labels.astype(np.int32)
 
 
 def native_batches(args, batch, steps):
@@ -295,6 +320,64 @@ def main(argv=None):
 
     total_steps = args.steps * args.epochs
     end_step = start_step + total_steps
+
+    if args.auto_resume:
+        if not args.save:
+            raise SystemExit("--auto-resume requires --save DIR (used as "
+                             "the rotating checkpoint directory)")
+        from apex_tpu.resilience import GuardConfig, TrainGuard
+
+        if args.data or args.loader == "native":
+            # non-seekable sources: resume continues from the iterator's
+            # current position; rollback is unavailable (the guard aborts
+            # with a clear error if it would be needed)
+            src = (native_batches(args, args.batch_size, total_steps)
+                   if args.loader == "native" else
+                   npz_batches(args.data, args.batch_size, total_steps))
+            batch_src = ((jax.device_put(x, batch_sharding),
+                          jax.device_put(y, batch_sharding))
+                         for x, y in src)
+        else:
+            def batch_src(step):
+                x, y = synthetic_batch_at(args.batch_size, args.seed, step)
+                return (jax.device_put(x, batch_sharding),
+                        jax.device_put(y, batch_sharding))
+
+        def gstep(carry, batch):
+            st, bn = carry
+            st, bn, loss, acc = train_step(st, bn, *batch)
+            return (st, bn), loss, acc
+
+        t_check = [time.perf_counter()]
+
+        def on_check(step, losses):
+            now = time.perf_counter()
+            ips = len(losses) * args.batch_size / max(now - t_check[0], 1e-9)
+            t_check[0] = now
+            print(f"Step [{step}/{total_steps}]  Speed {ips:.1f} img/s  "
+                  f"Loss {losses[-1]:.4f}", flush=True)
+
+        gcfg = GuardConfig(ckpt_dir=args.save,
+                           save_every_steps=args.save_every,
+                           check_every=max(1, args.print_freq),
+                           floor_patience=3)
+        guard = TrainGuard(gstep, gcfg, on_check=on_check)
+        with use_mesh(mesh):
+            (state, bn_state), rep = guard.run((state, bn_state), batch_src,
+                                               total_steps)
+        if rep.resumed_from is not None:
+            print(f"=> guard resumed from step {rep.resumed_from}")
+        print(f"=> guard: {rep.status} at step {rep.final_step}/{total_steps}"
+              f"  (rollbacks {rep.rollbacks}, faults {rep.faults_injected}, "
+              f"checkpoints {rep.checkpoints})", flush=True)
+        if args.validate and rep.status == "completed":
+            validate(args, cfg, state, bn_state, mesh, batch_sharding)
+        if rep.status != "completed":
+            # the watcher (tpu_watch.sh guard leg) keys its DONE marker
+            # on a zero exit: an interrupted run must read as retryable
+            raise SystemExit(3)
+        return None
+
     if args.loader == "native":
         batches = native_batches(args, args.batch_size, total_steps)
     elif args.data:

@@ -69,9 +69,9 @@ def run_sharded(opt, params, n_dev=8, iters=ITERS, mesh=None, specs=None,
     # the replication-typing validation additionally needs a jax with vma
     # typing: the 0.4-era check_rep cannot infer the allgathered outputs
     # replicated and rejects the step wholesale
-    has_vma = hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast")
+    from apex_tpu.utils.pallas import has_vma
     vma_kw = ({"check_vma": False}
-              if opt.impl == "fused" or not has_vma else {})
+              if opt.impl == "fused" or not has_vma() else {})
 
     @functools.partial(
         shard_map, mesh=mesh,

@@ -151,7 +151,8 @@ def test_syncbn_backward_matches_oracle(mesh):
 
     # the 0.4-era check_rep cannot infer the autodiff-psummed gw/gb
     # replicated (a jax with vma typing can); disable the check there
-    has_vma = hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast")
+    from apex_tpu.utils.pallas import has_vma
+    has_vma = has_vma()
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(P("data"), P(), P()),

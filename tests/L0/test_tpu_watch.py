@@ -33,6 +33,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_KERN_TO": "30",
            "APEX_WATCH_TRAIN_TO": "30",
            "APEX_WATCH_TRAIN_CMD": "",
+           "APEX_WATCH_GTRAIN_TO": "30",
+           "APEX_WATCH_GTRAIN_CMD": "",
            "APEX_WATCH_SMOKE_CMD": "echo smoke-ok",
            "APEX_WATCH_APPLY_CMD": "echo applied",
            "PYTHONPATH": ROOT,
@@ -140,6 +142,50 @@ def test_train_failure_never_blocks_later_stages(tmp_path):
     assert "Step 1 Loss 2.0" in (
         tmp_path / "TRAIN_LOG_r5_failed.txt").read_text()
     assert not (tmp_path / "TRAIN_LOG_r5.txt").exists()
+
+
+def test_guard_train_leg_incremental_across_windows(tmp_path):
+    """Stage 3a (guard-driven resumable train): an interrupted leg
+    (rc!=0) leaves no DONE marker and blocks nothing — the next window
+    re-runs it (appending to the same log, as a guard resume would); a
+    completed leg (rc=0) writes the DONE marker and later windows skip
+    it entirely."""
+    calls = tmp_path / "gtrain_calls"
+    gtrain = tmp_path / "fake_gtrain.sh"
+    # invocation 1 simulates a flap mid-run (guard exits 3, checkpoints
+    # keep the progress); invocation 2 completes
+    gtrain.write_text(f"""#!/bin/bash
+n=$(cat {calls} 2>/dev/null || echo 0)
+echo $((n+1)) > {calls}
+echo "guard window $n"
+if [ "$n" -eq 0 ]; then exit 3; fi
+""")
+    env = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_GTRAIN_CMD": f"bash {gtrain}",
+    }
+    # window 1: the leg is interrupted — later stages still run, no DONE
+    r, log = run_watch(tmp_path, env)
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    assert "guard train leg done rc=3" in log
+    assert "checkpoints carry progress to the next window" in log
+    assert not (tmp_path / "TRAIN_GUARD_DONE").exists()
+    assert (tmp_path / "TUNNEL_LIVE").exists()    # leg never blocks exit
+    # window 2 (fresh watcher run): the leg re-runs and completes
+    (tmp_path / "TUNNEL_LIVE").unlink()
+    r, log = run_watch(tmp_path, env)
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    assert "guard train leg done rc=0" in log
+    assert (tmp_path / "TRAIN_GUARD_DONE").exists()
+    # the log APPENDED across windows — both invocations are in it
+    gl = (tmp_path / "TRAIN_GUARD_r5.txt").read_text()
+    assert "guard window 0" in gl and "guard window 1" in gl
+    # window 3: the DONE marker skips the leg (no third invocation)
+    r, log = run_watch(tmp_path, env)
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    assert calls.read_text().strip() == "2"
 
 
 def test_kernels_run_first_when_bench_already_complete(tmp_path):

@@ -417,6 +417,29 @@ def test_decide_fuse_win_with_unparsable_best_fused_skips_dkv_keys():
     assert "flash_bwd_dkv_block_k" not in prof
 
 
+def test_decide_failed_dq_ladder_with_fused_measured_pins_fuse_true():
+    """Every dq row failed while dkv+fused measured (ROADMAP deferral a):
+    the split total is unmeasurable, so flash_bwd_fuse must be pinned
+    True (fused is the only strategy with on-chip evidence) and the dkv
+    keys must carry best_fused — previously the key stayed unwritten
+    while best_dkv shipped, letting the runtime byte-cap heuristic pair
+    a fused pick with split-measured blocks."""
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    bt = kern["kernels"]["flash_bwd_autotune"]
+    for c in list(bt["sweep_ms"]):
+        if c.startswith("dq_"):
+            bt["sweep_ms"][c] = "failed: Mosaic lowering"
+    bt["best_dq"] = None
+    prof, rows = mod.decide(bench, kern)
+    assert prof["flash_bwd_fuse"] is True
+    # dkv keys carry the measured FUSED winner, not the split dkv one
+    assert prof["flash_bwd_dkv_block_q"] == 128
+    assert prof["flash_bwd_dkv_block_k"] == 256
+    assert "flash_bwd_dq_block_q" not in prof
+    assert any("only" in e and "measured" in e for _, _, e in rows)
+
+
 def test_decide_failed_fused_ladder_records_fuse_false():
     """A fused ladder with no measured row must write flash_bwd_fuse=False:
     leaving the key absent would let the runtime byte-cap heuristic
@@ -558,6 +581,12 @@ def test_resolve_fuse_chain(profile, fake_tpu, monkeypatch):
     # heuristic: small dq-partials buffer -> fuse; past the cap -> split
     assert F._resolve_fuse(None, 4, 128, 128, 64, 128) is True
     assert F._resolve_fuse(None, 64, 16384, 16384, 64, 128) is False
+    # 'off'/'no' disable, same vocabulary as telemetry's _env_enabled
+    # (they used to read as truthy — ROADMAP deferral b)
+    for off in ("off", "no", "0", "false"):
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD_FUSE", off)
+        assert F._resolve_fuse(None, 4, 128, 128, 64, 128) is False, off
+    monkeypatch.delenv("APEX_TPU_FLASH_BWD_FUSE")
     monkeypatch.setenv("APEX_TPU_FLASH_BWD_FUSE_MB", "0.001")
     assert F._resolve_fuse(None, 4, 128, 128, 64, 128) is False
     monkeypatch.delenv("APEX_TPU_FLASH_BWD_FUSE_MB")

@@ -55,7 +55,8 @@ def run_config_dp(opt_level, loss_scale=None, steps=STEPS):
 
     # the replicated-out_specs typing is only inferable on a jax with vma
     # typing; the 0.4-era check_rep rejects the psum'd updates wholesale
-    has_vma = hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast")
+    from apex_tpu.utils.pallas import has_vma
+    has_vma = has_vma()
 
     @jax.jit
     @functools.partial(

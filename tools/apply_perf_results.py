@@ -196,6 +196,21 @@ def decide(bench, kern):
                              if fused is not None else
                              f"no fused row measured; split "
                              f"{round(dq_ms + dkv_ms, 3)} ms"))
+            elif fused is not None:
+                # the split total is unmeasurable (a dq or dkv ladder
+                # with no surviving row) while the fused ladder DID
+                # measure: fused is the only strategy with on-chip
+                # evidence, so pin it on.  Leaving flash_bwd_fuse
+                # unwritten here would let the runtime byte-cap
+                # heuristic pick the fused kernel while the dkv keys
+                # below carried best_dkv — split-measured blocks the
+                # fused kernel never ran at (ROADMAP deferral a).
+                fuse = True
+                prof["flash_bwd_fuse"] = True
+                rows.append(("flash_bwd_fuse", "true",
+                             f"fused {fused} ms; split total unmeasured "
+                             f"(dq {dq_ms} ms, dkv {dkv_ms} ms) — only "
+                             f"measured strategy"))
 
             qk = _cfg(bt.get("best_dq"))
             if qk:
