@@ -107,14 +107,27 @@ def _fault_stall(step: int) -> float:
 
 def _record_loader(depth, wait_s) -> None:
     """Telemetry loader meter (docs/telemetry.md): consumer wait per
-    batch + ring/queue depth after the dequeue.  A single attribute
-    check when no default registry is installed; import kept local so
-    the loader stays importable without the apex_tpu package root."""
+    batch + ring/queue depth after the dequeue (also a ``loader.wait``
+    span when a tracer is installed).  A single attribute check when no
+    default registry/tracer is installed; import kept local so the
+    loader stays importable without the apex_tpu package root."""
     try:
         from ..telemetry import events as _tel_events
     except ImportError:  # pragma: no cover - standalone module use
         return
     _tel_events.record_loader(depth, wait_s)
+
+
+def _note_fill_span(batch_index, fill_s) -> None:
+    """Producer-side ``loader.fill`` span (docs/telemetry.md tracing):
+    how long each batch took to ASSEMBLE, recorded from the fill
+    thread — the other half of the wait/fill pair a stall diagnosis
+    needs.  No-op (one attribute check) without an installed tracer."""
+    try:
+        from ..telemetry import trace as _trace
+    except ImportError:  # pragma: no cover - standalone module use
+        return
+    _trace.note_span("loader.fill", fill_s, batch=batch_index)
 
 
 def _put_checking_stop(q, item, stop) -> bool:
@@ -295,9 +308,11 @@ class NativeLoader:
             rng = np.random.RandomState(self.seed & 0x7fffffff)
             n = (1 if synthetic else self.source.data.shape[0])
             order = None
+            import time as _time
             for t in range(self.steps):
                 if stop.is_set():
                     return
+                t0 = _time.perf_counter()
                 if synthetic:
                     x = rng.uniform(-1, 1, self._shape).astype(np.float32)
                     y = rng.randint(0, self.source.n_classes,
@@ -313,6 +328,7 @@ class NativeLoader:
                     y = (self.source.labels[idx]
                          if self.source.labels is not None
                          else np.zeros(self.batch_size, np.int32))
+                _note_fill_span(t, _time.perf_counter() - t0)
                 if not _put_checking_stop(q, (x, y), stop):
                     return
             _put_checking_stop(q, None, stop)

@@ -70,9 +70,10 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
     # comms-perf decisions read).  The wall time is HOST time around
     # building the reduction (trace/dispatch cost under jit — on-device
     # collective time belongs to the profiler).  One attribute check
-    # when no registry is installed.
+    # when no registry/tracer is installed (``metering`` covers both:
+    # the span tracer consumes the same measurement).
     from ..telemetry import events as _tel_events
-    _meter = {"bytes": 0, "leaves": 0} if _tel_events.active() else None
+    _meter = {"bytes": 0, "leaves": 0} if _tel_events.metering() else None
     _t0 = time.perf_counter() if _meter is not None else None
 
     pre = 1.0

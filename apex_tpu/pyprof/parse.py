@@ -52,24 +52,22 @@ def _latest_trace_file(logdir: str) -> str:
     return max(hits, key=os.path.getmtime)
 
 
-def load(logdir: str) -> list[dict[str, Any]]:
-    """Read the newest trace in ``logdir``; returns complete-span events,
-    each annotated with its process/thread display names."""
-    path = _latest_trace_file(logdir)
-    with gzip.open(path, "rt") as f:
-        data = json.load(f)
-    raw = data.get("traceEvents", [])
+def events_from_chrome(raw: list) -> list[dict[str, Any]]:
+    """Complete-span ("X") events from a raw Chrome traceEvents list,
+    each annotated with its process/thread display names (from the "M"
+    metadata events).  Shared by this module's profiler-dir loader and
+    ``telemetry.trace.load_chrome`` — one place owns the event shape."""
     pname: dict[Any, str] = {}
     tname: dict[tuple, str] = {}
     for e in raw:
-        if e.get("ph") == "M":
+        if isinstance(e, dict) and e.get("ph") == "M":
             if e.get("name") == "process_name":
                 pname[e.get("pid")] = e["args"]["name"]
             elif e.get("name") == "thread_name":
                 tname[(e.get("pid"), e.get("tid"))] = e["args"]["name"]
     out = []
     for e in raw:
-        if e.get("ph") != "X":
+        if not isinstance(e, dict) or e.get("ph") != "X":
             continue
         out.append({
             "name": e.get("name", "?"),
@@ -83,6 +81,15 @@ def load(logdir: str) -> list[dict[str, Any]]:
             "args": e.get("args", {}),
         })
     return out
+
+
+def load(logdir: str) -> list[dict[str, Any]]:
+    """Read the newest trace in ``logdir``; returns complete-span events,
+    each annotated with its process/thread display names."""
+    path = _latest_trace_file(logdir)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    return events_from_chrome(data.get("traceEvents", []))
 
 
 def _self_times(events: list[dict]) -> None:

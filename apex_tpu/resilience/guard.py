@@ -64,8 +64,8 @@ class GuardAbort(RuntimeError):
 
 
 def _env_enabled() -> bool:
-    return os.environ.get("APEX_TPU_GUARD", "1").lower() not in (
-        "0", "off", "false", "no")
+    from ..telemetry.trace import env_flag   # the one boolean-env parser
+    return env_flag("APEX_TPU_GUARD")
 
 
 @dataclasses.dataclass
@@ -77,8 +77,10 @@ class GuardConfig:
     every checkpoint is health-screened before it is written.
     ``floor_patience`` counts consecutive *checks* (not steps) the
     dynamic loss scale sits at its floor before escalating; 0 disables
-    that detector.  ``enabled=None`` reads ``APEX_TPU_GUARD`` (default
-    on)."""
+    that detector.  ``flight_dir`` is where flight-recorder dumps land
+    on rollback/preempt/exception (default: the tracer's own directory,
+    else next to the checkpoints).  ``enabled=None`` reads
+    ``APEX_TPU_GUARD`` (default on)."""
     ckpt_dir: Optional[str] = None
     save_every_steps: int = 0
     save_every_seconds: float = 0.0
@@ -90,6 +92,7 @@ class GuardConfig:
     backoff_seconds: float = 0.25
     save_on_exit: bool = True
     auto_resume: bool = True
+    flight_dir: Optional[str] = None
     enabled: Optional[bool] = None
 
     def __post_init__(self):
@@ -112,6 +115,29 @@ class GuardReport:
     checkpoints: int = 0
 
 
+def _observed_save(manager: CheckpointManager, step: int, payload,
+                   registry=None) -> str:
+    """``manager.save`` wrapped in the checkpoint observability hooks
+    (docs/telemetry.md): a ``ckpt.write`` span through the default
+    tracer and write-duration / bytes-written gauges through
+    ``registry`` (the guard's pinned registry, like every other guard
+    emission) or the process default.  Runs on whichever thread saves —
+    the background writer included — so both hooks are thread-safe
+    (lock-protected tracer, atomic gauge assignment)."""
+    from ..telemetry import events as _tel_events
+    from ..telemetry import trace as _trace
+    t0 = time.perf_counter()
+    with _trace.span("ckpt.write", step=step):
+        path = manager.save(step, payload)
+    dur = time.perf_counter() - t0
+    try:
+        nbytes = os.path.getsize(path)
+    except OSError:   # pragma: no cover - raced rotation
+        nbytes = 0
+    _tel_events.record_ckpt(dur, nbytes, reg=registry)
+    return path
+
+
 class _AsyncWriter:
     """Background checkpoint writer: the main loop hands (step, host
     payload) over a small bounded queue and keeps stepping while the
@@ -119,8 +145,9 @@ class _AsyncWriter:
     the next submit/drain — silently losing checkpoints would void the
     resume guarantee."""
 
-    def __init__(self, manager: CheckpointManager):
+    def __init__(self, manager: CheckpointManager, registry=None):
         self._manager = manager
+        self._registry = registry
         self._q: "queue.Queue" = queue.Queue(maxsize=2)
         self._exc: Optional[BaseException] = None
         self._thread = threading.Thread(
@@ -136,7 +163,8 @@ class _AsyncWriter:
                     return
                 step, payload = item
                 try:
-                    self._manager.save(step, payload)
+                    _observed_save(self._manager, step, payload,
+                                   registry=self._registry)
                     self.written += 1
                 except BaseException as e:
                     self._exc = e
@@ -211,9 +239,32 @@ class TrainGuard:
         if reg is None:
             from ..telemetry import events as _events
             reg = _events.get_default()
-        if reg is None or not reg.enabled:
-            return
-        reg.event(name, **fields)
+        if reg is not None and reg.enabled:
+            reg.event(name, **fields)   # the registry copies the event
+            return                      # into the flight ring itself
+        from ..telemetry import trace as _trace
+        _trace.note_event(name, step=fields.get("step"), fields=fields)
+
+    def _dump_flight(self, reason: str, step: int, **fields):
+        """Dump the flight recorder on a guard lifecycle failure
+        (rollback / preempt / unhandled exception).  Destination:
+        ``cfg.flight_dir`` > the tracer's own directory > next to the
+        checkpoints.  Best-effort — a failed dump never fails the run.
+        Returns the written path (or None)."""
+        from ..telemetry import trace as _trace
+        tr = _trace.get_tracer()
+        if tr is None or not tr.enabled:
+            return None
+        directory = (self.cfg.flight_dir or tr.recorder.directory
+                     or (self.manager.directory if self.manager else None))
+        if directory is None:
+            return None
+        try:
+            return tr.recorder.dump(reason, step=step, directory=directory,
+                                    fields=fields)
+        except Exception:   # disk full, or an off-schema ring entry —
+            return None     # a failed dump must never mask the real
+                            # error propagating through run()
 
     # -- state <-> host ------------------------------------------------------
     def _snapshot(self, state, step: int) -> dict:
@@ -325,11 +376,14 @@ class TrainGuard:
         mgr = self.manager
         step = start_step
 
+        from ..telemetry import trace as _trace
+
         if mgr is not None and cfg.auto_resume:
             found = mgr.load_latest()
             if found is not None and found[0] > start_step:
                 ck_step, payload = found
-                state = self._restore(state, payload)
+                with _trace.span("ckpt.restore", step=found[0]):
+                    state = self._restore(state, payload)
                 step = min(ck_step, num_steps)
                 report.resumed_from = ck_step
                 self._emit("resumed", step=ck_step)
@@ -342,19 +396,22 @@ class TrainGuard:
 
         self._stop = False
         prev_handlers = self._install_handlers()
-        writer = _AsyncWriter(mgr) if mgr is not None else None
+        writer = (_AsyncWriter(mgr, registry=self._registry)
+                  if mgr is not None else None)
         pending: List[Tuple[int, Any]] = []   # (step, device loss)
         since_check = 0    # steps since the last boundary — NOT len(pending):
         # a loss-less step fn must still hit the checkpoint cadence
         self._streak = 0
         self._floor_checks = 0
+        self._last_bad_step: Optional[int] = None
         last_saved = step
         t_last_save = time.monotonic()
         try:
             if mgr is not None and step < num_steps:
                 # rollback anchor: escalation before the first cadence
                 # save must still have somewhere to go
-                mgr.save(step, self._snapshot(state, step))
+                _observed_save(mgr, step, self._snapshot(state, step),
+                               registry=self._registry)
                 report.checkpoints += 1
             while step < num_steps:
                 if plan is not None and not self._stop \
@@ -380,7 +437,8 @@ class TrainGuard:
                 if not (since_check >= cfg.check_every
                         or step >= num_steps or self._stop):
                     continue
-                healthy = self._health_check(state, pending)
+                with _trace.span("guard.health_check", step=step):
+                    healthy = self._health_check(state, pending)
                 pending.clear()             # window consumed either way
                 since_check = 0
                 if not healthy:
@@ -402,15 +460,24 @@ class TrainGuard:
                         t_last_save = time.monotonic()
             if mgr is not None and (self._stop or cfg.save_on_exit):
                 writer.drain()
-                mgr.save(step, self._snapshot(state, step))
+                _observed_save(mgr, step, self._snapshot(state, step),
+                               registry=self._registry)
                 report.checkpoints += 1
             if self._stop:
                 report.status = "preempted"
                 self._emit("preempted", step=step)
+                self._dump_flight("preempt", step)
             report.final_step = step
             if writer is not None:
                 writer.drain()
             return state, report
+        except BaseException as e:
+            # the crash flight recorder: whatever ran in the seconds
+            # before an unhandled error (GuardAbort included) is written
+            # out before the exception propagates
+            self._dump_flight("exception", step, error=repr(e)[:200],
+                              error_type=type(e).__name__)
+            raise
         finally:
             if writer is not None:
                 writer.close()
@@ -432,8 +499,14 @@ class TrainGuard:
             return True
         host = jax.device_get(arrays)
         losses = [float(v) for v in host[:len(pending)]]
-        for v in losses:
-            self._streak = 0 if np.isfinite(v) else self._streak + 1
+        for (st, _), v in zip(pending, losses):
+            if np.isfinite(v):
+                self._streak = 0
+                self._last_bad_step = None   # a recovered transient must
+                # not be named by a LATER, unrelated rollback's dump
+            else:
+                self._streak += 1
+                self._last_bad_step = st   # the flight dump names it
         if scaler is not None and cfg.floor_patience:
             from ..amp import scaler as _scaler_mod
             pinned = _scaler_mod.floor_pinned(scaler, float(host[-1]))
@@ -467,10 +540,16 @@ class TrainGuard:
             raise GuardAbort(f"escalation ({why}) but no readable "
                              f"checkpoint under {self.manager.directory}")
         ck_step, payload = found
-        state = self._restore(state, payload)
+        from ..telemetry import trace as _trace
+        with _trace.span("ckpt.restore", step=ck_step, rollback=True):
+            state = self._restore(state, payload)
         self._streak = 0
         self._floor_checks = 0
         self._emit("rollback", to_step=ck_step, attempt=report.rollbacks,
                    reason=why)
+        self._dump_flight("rollback", ck_step, why=why,
+                          attempt=report.rollbacks, to_step=ck_step,
+                          bad_step=self._last_bad_step)
+        self._last_bad_step = None     # consumed by this dump
         time.sleep(cfg.backoff_seconds * (2 ** (report.rollbacks - 1)))
         return state, ck_step

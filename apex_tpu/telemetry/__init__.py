@@ -1,6 +1,6 @@
 """apex_tpu.telemetry — training-telemetry subsystem.
 
-Four pieces (see docs/telemetry.md):
+Five pieces (see docs/telemetry.md):
 
   * :mod:`registry`  — counters/gauges/histograms/meters with a
     host-sync-batching ``step()`` context, rank-0-gated JSONL emission
@@ -9,10 +9,16 @@ Four pieces (see docs/telemetry.md):
   * :mod:`events`    — structured events wired into the existing hook
     points (amp scaler halve/double transitions, DDP collective meters,
     loader queue gauges) through a process-default registry;
+  * :mod:`trace`     — host-side span tracer (Chrome/Perfetto export),
+    the bounded flight-recorder ring the resilience guard dumps on
+    rollback/preempt/crash, and the slow-step sentinel that can open a
+    one-shot ``jax.profiler`` capture on a step-time anomaly;
   * :mod:`attrib`    — per-op FLOPs/bytes attribution over the compiled
-    HLO (the per-fusion refinement of ``pyprof.prof.cost_report``);
+    HLO (the per-fusion refinement of ``pyprof.prof.cost_report``),
+    with blas/conv/pointwise/reduction/collective op-class rollups;
   * :mod:`report`    — JSONL → step-metrics summary +
-    ``python -m apex_tpu.telemetry`` CLI.
+    ``python -m apex_tpu.telemetry`` CLI (``trace <file>`` renders the
+    span-timeline summary).
 
 The reference has no counterpart: its observability is rank-0 prints
 and an ``AverageMeter`` whose docstring warns that printing costs an
@@ -22,18 +28,24 @@ for the comms-efficiency work (EQuARX-style quantized collectives,
 cross-replica sharding) that needs per-collective byte/step-time
 accounting before it can claim a win.
 """
+from . import trace
 from . import registry
 from . import events
 from .registry import (SCHEMA, Registry, Counter, Gauge, Histogram,
                        AverageMeter, Throughput, JsonlSink, MemorySink,
                        NULL_METRIC, record_violations, records_violations)
 from .events import (set_default, get_default, active, observe_scaler,
-                     observe_amp, record_collective, record_loader)
+                     observe_amp, record_collective, record_loader,
+                     record_ckpt)
+from .trace import (Tracer, FlightRecorder, SlowStepSentinel, NULL_SPAN,
+                    set_tracer, get_tracer, span, traced)
 
 __all__ = [
-    "registry", "events", "SCHEMA", "Registry", "Counter", "Gauge",
+    "trace", "registry", "events", "SCHEMA", "Registry", "Counter", "Gauge",
     "Histogram", "AverageMeter", "Throughput", "JsonlSink", "MemorySink",
     "NULL_METRIC", "record_violations", "records_violations",
     "set_default", "get_default", "active", "observe_scaler",
-    "observe_amp", "record_collective", "record_loader",
+    "observe_amp", "record_collective", "record_loader", "record_ckpt",
+    "Tracer", "FlightRecorder", "SlowStepSentinel", "NULL_SPAN",
+    "set_tracer", "get_tracer", "span", "traced",
 ]

@@ -1,6 +1,9 @@
 """``python -m apex_tpu.telemetry`` — render a run's JSONL (or run the
 instrumented-transformer demo) into the per-op FLOPs/bytes table and the
-step-metrics summary.  See ``report.main`` for the flags."""
+step-metrics summary; ``python -m apex_tpu.telemetry trace <file>``
+renders the span-timeline summary from a Chrome-trace file (a
+``Tracer.write`` export, a ``tpu_watch.sh`` stage timeline, or a
+jax-profiler run dir).  See ``report.main`` for the flags."""
 from .report import main
 
 if __name__ == "__main__":

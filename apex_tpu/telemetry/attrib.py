@@ -45,6 +45,66 @@ _SKIP = frozenset((
     "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
     "after-all", "partition-id", "replica-id", "opt-barrier"))
 
+# --- op classes (the reference's per-module breadth: apex/pyprof/prof/
+# splits its tables across blas.py, conv.py, pointwise.py, reduction.py,
+# ... — here each post-fusion op is binned into the same vocabulary so
+# the table can roll up per class) ------------------------------------------
+
+OP_CLASSES = ("blas", "conv", "reduction", "collective", "memory",
+              "pointwise", "other")
+
+_CLASS_COLLECTIVE = frozenset((
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "collective-broadcast", "send", "recv"))
+_CLASS_MEMORY = frozenset((
+    "copy", "transpose", "broadcast", "reshape", "slice", "concatenate",
+    "pad", "reverse", "gather", "scatter", "dynamic-slice",
+    "dynamic-update-slice", "iota", "convert", "copy-start", "copy-done"))
+_CLASS_REDUCTION = frozenset(("reduce", "reduce-window",
+                              "select-and-scatter"))
+_CLASS_OTHER = frozenset((
+    "custom-call", "rng", "rng-bit-generator", "sort", "while",
+    "conditional", "call", "infeed", "outfeed", "fft", "triangular-solve",
+    "cholesky"))
+
+
+def op_class(opcode: str) -> str:
+    """Bin one HLO opcode into its pyprof-style op class.  ``fusion``
+    is classified by :func:`parse_hlo` from its fused computation's
+    content (a fusion wrapping a dot is blas work, not pointwise)."""
+    if opcode == "dot":
+        return "blas"
+    if opcode == "convolution":
+        return "conv"
+    if opcode in _CLASS_REDUCTION:
+        return "reduction"
+    if opcode in _CLASS_COLLECTIVE:
+        return "collective"
+    if opcode in _CLASS_MEMORY:
+        return "memory"
+    if opcode in _CLASS_OTHER:
+        return "other"
+    return "pointwise"        # elementwise + transcendental default
+
+
+def _fused_class(instrs) -> str:
+    """Dominant class of a fused computation, by the same priority the
+    reference gives its tables: math classes first (a fusion containing
+    a dot is blas work), then pointwise if any elementwise math exists,
+    and only a fusion of PURE data movement counts as memory —
+    otherwise the rollup would launder transpose/copy fusions into the
+    pointwise bucket and under-report memory traffic."""
+    classes = {op_class(i["opcode"]) for i in instrs
+               if i["opcode"] not in _SKIP}
+    # "memory" LAST: only a fusion of pure data movement counts as
+    # memory — a sort/custom-call fusion with a slice in it is "other"
+    # work, not memory traffic
+    for c in ("blas", "conv", "reduction", "collective", "pointwise",
+              "other", "memory"):
+        if c in classes:
+            return c
+    return "pointwise"
+
 _SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
 _INSTR_RE = re.compile(
     r"^\s+(?:ROOT\s+)?%?(?P<var>[\w.\-]+)\s*=\s*(?P<type>\([^=]*?\)|\S+)\s+"
@@ -221,8 +281,10 @@ def parse_hlo(text: str) -> List[dict]:
     if entry is None and comp_order:
         entry = comp_order[-1]   # HLO text always ends with ENTRY
 
-    # FLOPs for fused computations first (fusions reference them)
+    # FLOPs + dominant class for fused computations first (fusions
+    # reference them)
     fused_flops: Dict[str, tuple] = {}
+    fused_cls: Dict[str, str] = {}
     for name, instrs in computations.items():
         if name == entry:
             continue
@@ -235,6 +297,7 @@ def parse_hlo(text: str) -> List[dict]:
             fl += f
             tr += t
         fused_flops[name] = (fl, tr)
+        fused_cls[name] = _fused_class(instrs)
 
     rows: List[dict] = []
     for ins in computations.get(entry, ()):
@@ -242,8 +305,13 @@ def parse_hlo(text: str) -> List[dict]:
             continue
         f, t = _instr_flops(ins["opcode"], ins["out_elems"], ins["rest"],
                             fused_flops)
+        cls = op_class(ins["opcode"])
+        if ins["opcode"] == "fusion":
+            m = _CALLS_RE.search(ins["rest"])
+            cls = fused_cls.get(m.group(1), "pointwise") if m \
+                else "pointwise"
         rows.append({
-            "op": ins["op"], "opcode": ins["opcode"],
+            "op": ins["op"], "opcode": ins["opcode"], "class": cls,
             "jax_op": ins["jax_op"], "flops": f, "transcendentals": t,
             "bytes": float(ins["operand_bytes"] + ins["out_bytes"]),
             "out_bytes": float(ins["out_bytes"]),
@@ -294,6 +362,7 @@ def op_table(fn: Callable, *args, static_argnums=(), donate_argnums=(),
     total_flops = sum(r["flops"] for r in rows)
     total_bytes = sum(r["bytes"] for r in rows)
     by_opcode: Dict[str, dict] = {}
+    by_class: Dict[str, dict] = {}
     for r in rows:
         r["intensity"] = r["flops"] / r["bytes"] if r["bytes"] else 0.0
         r["projected_us"] = 1e6 * max(r["flops"] / pf, r["bytes"] / pb)
@@ -306,12 +375,23 @@ def op_table(fn: Callable, *args, static_argnums=(), donate_argnums=(),
         agg["count"] += 1
         agg["flops"] += r["flops"]
         agg["bytes"] += r["bytes"]
+        cagg = by_class.setdefault(
+            r["class"], {"count": 0, "flops": 0.0, "bytes": 0.0})
+        cagg["count"] += 1
+        cagg["flops"] += r["flops"]
+        cagg["bytes"] += r["bytes"]
+    for c in by_class.values():
+        c["pct_flops"] = 100.0 * c["flops"] / total_flops if total_flops \
+            else 0.0
+        c["pct_bytes"] = 100.0 * c["bytes"] / total_bytes if total_bytes \
+            else 0.0
     rows.sort(key=lambda r: (r["flops"], r["bytes"]), reverse=True)
 
     return {
         "platform": platform,
         "rows": rows,
         "by_opcode": by_opcode,
+        "by_class": by_class,
         "total_flops": total_flops,
         "total_bytes": total_bytes,
         "module_flops": _first(cost, "flops"),
@@ -351,6 +431,17 @@ def format_op_table(table: dict, top: int = 20) -> str:
         rest_b = sum(r["bytes"] for r in rows[top:])
         lines.append(f"{'... ' + str(len(rows) - top) + ' more ops':<49} "
                      f"{_human(rest_f):>10} {_human(rest_b):>10}")
+    by_class = table.get("by_class") or {}
+    if by_class:
+        lines.append("per-class rollup (pyprof prof/ vocabulary)")
+        for cls in OP_CLASSES:
+            agg = by_class.get(cls)
+            if agg is None:
+                continue
+            lines.append(
+                f"  {cls:<32} {agg['count']:>4} ops   "
+                f"{_human(agg['flops']):>10} {_human(agg['bytes']):>10} "
+                f"{agg['pct_flops']:>6.1f}% {agg['pct_bytes']:>6.1f}%")
     lines.append(
         f"parsed totals       {_human(table['total_flops'], 'FLOP')} / "
         f"{_human(table['total_bytes'], 'B')}  (compiler cost model: "

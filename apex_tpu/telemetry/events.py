@@ -32,6 +32,7 @@ from __future__ import annotations
 from typing import Optional
 
 from . import registry as _registry
+from . import trace as _trace
 
 
 # -- default-registry plumbing (lives here so the hooks avoid importing
@@ -60,6 +61,14 @@ def active() -> bool:
     return _default is not None and _default.enabled
 
 
+def metering() -> bool:
+    """True when EITHER a default registry or a default tracer is
+    installed — instrumented library code (the DDP collective meter)
+    measures when anything downstream will consume it, and stays free
+    otherwise."""
+    return active() or _trace.active()
+
+
 # -- amp scaler transitions --------------------------------------------------
 
 def observe_scaler(reg, prev, new, *, loss_id: int = 0) -> Optional[str]:
@@ -77,8 +86,9 @@ def observe_scaler(reg, prev, new, *, loss_id: int = 0) -> Optional[str]:
         return None
     import jax
     from ..amp import scaler as _scaler
-    ps, ns, pu, nu = (float(v) for v in jax.device_get(
-        (prev.loss_scale, new.loss_scale, prev.unskipped, new.unskipped)))
+    with _trace.span("amp.observe_scaler", loss_id=loss_id):
+        ps, ns, pu, nu = (float(v) for v in jax.device_get(
+            (prev.loss_scale, new.loss_scale, prev.unskipped, new.unskipped)))
     kind = _scaler.transition_kind(ps, ns, pu, nu,
                                    scale_window=prev.scale_window,
                                    min_loss_scale=prev.min_loss_scale,
@@ -110,6 +120,8 @@ def record_collective(axis_name: str, nbytes: int, n_leaves: int,
     """DDP collective meter: bytes reduced + wall time per
     ``allreduce_tree``/``Reducer.reduce`` call.  See module docstring
     for the trace-time semantics under jit."""
+    _trace.note_span("ddp.allreduce", seconds, axis=axis_name,
+                     bytes=int(nbytes), leaves=int(n_leaves))
     if not active():
         return
     reg = _default
@@ -124,6 +136,8 @@ def record_collective(axis_name: str, nbytes: int, n_leaves: int,
 def record_loader(depth: Optional[int], wait_seconds: float) -> None:
     """Loader meter: consumer wait per batch, ring/queue depth after the
     dequeue (None when the native ring can't report it)."""
+    _trace.note_span("loader.wait", wait_seconds,
+                     **({} if depth is None else {"depth": depth}))
     if not active():
         return
     reg = _default
@@ -131,3 +145,19 @@ def record_loader(depth: Optional[int], wait_seconds: float) -> None:
     if depth is not None:
         reg.gauge("loader.queue_depth").set(depth)
         reg.histogram("loader.depth_samples").observe(depth)
+
+
+def record_ckpt(seconds: float, nbytes: int, reg=None) -> None:
+    """Checkpoint-write meter, called from the guard's BACKGROUND
+    writer thread after each ``CheckpointManager.save``: write duration
+    and bytes-written gauges (gauge set is a single atomic assignment,
+    so the off-thread emit never races the main thread's flush).
+    ``reg`` pins a registry (a guard constructed with ``registry=...``
+    must meter into IT, like every other guard emission); default: the
+    process default."""
+    if reg is None:
+        reg = _default
+    if reg is None or not reg.enabled:
+        return
+    reg.gauge("ckpt.write_ms").set(seconds * 1e3)
+    reg.gauge("ckpt.bytes_written").set(float(nbytes))

@@ -29,8 +29,20 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
+
+try:                        # package import (the normal case)
+    from . import trace as _trace
+except ImportError:         # standalone file-based load: tools/
+    # apply_perf_results.py execs this file OUTSIDE the package to
+    # audit SCHEMA without importing jax — the tracing hooks (span
+    # ring, sentinel) become no-ops there
+    class _trace:           # noqa: N801 - module-shaped shim
+        note_event = staticmethod(lambda *a, **k: None)
+        note_flush = staticmethod(lambda *a, **k: None)
+        note_step = staticmethod(lambda *a, **k: None)
 
 # ---------------------------------------------------------------------------
 # record schema (the committed JSONL contract)
@@ -387,8 +399,10 @@ class Throughput:
 # ---------------------------------------------------------------------------
 
 def _env_enabled() -> bool:
-    return os.environ.get("APEX_TPU_TELEMETRY", "1").lower() not in (
-        "0", "off", "false", "no")
+    flag = getattr(_trace, "env_flag", None)   # absent under the shim,
+    # which only audits SCHEMA and never constructs a Registry —
+    # default on rather than carrying a second copy of the parser
+    return True if flag is None else flag("APEX_TPU_TELEMETRY")
 
 
 class Registry:
@@ -424,6 +438,10 @@ class Registry:
         self.rank0_only = rank0_only
         self.run_id = run_id
         self._metrics: Dict[str, Any] = {}
+        # guards metric CREATION only: the guard's background ckpt
+        # writer may mint its gauges while the main thread flushes
+        # (updates stay lock-free — appends/assignments are atomic)
+        self._metrics_lock = threading.Lock()
         self._events: List[dict] = []
         self._step = 0
         self._wrote_meta = False
@@ -434,8 +452,11 @@ class Registry:
             return NULL_METRIC
         m = self._metrics.get(name)
         if m is None:
-            m = self._metrics[name] = cls(name)
-        elif not isinstance(m, cls):
+            with self._metrics_lock:
+                m = self._metrics.get(name)      # lost the race?
+                if m is None:
+                    m = self._metrics[name] = cls(name)
+        if not isinstance(m, cls):
             raise TypeError(f"metric {name!r} already registered as "
                             f"{type(m).__name__}, not {cls.__name__}")
         return m
@@ -462,6 +483,10 @@ class Registry:
         self._events.append({"kind": "event", "ts": _ts(),
                              "step": self._step, "name": name,
                              "fields": fields})
+        # real-time copy into the flight-recorder ring (one attribute
+        # check when no tracer is installed): a crash dump must hold
+        # the events from BEFORE the flush that never happened
+        _trace.note_event(name, step=self._step, fields=fields)
 
     # -- the step context ---------------------------------------------------
     @contextlib.contextmanager
@@ -475,8 +500,12 @@ class Registry:
         self._step += 1
         t0 = time.perf_counter()
         yield self
-        self.histogram("step_time_ms").observe(
-            (time.perf_counter() - t0) * 1e3)
+        dt = time.perf_counter() - t0
+        self.histogram("step_time_ms").observe(dt * 1e3)
+        # span + slow-step sentinel through the default tracer (one
+        # attribute check when none is installed); THIS registry rides
+        # along so a sentinel fire is recorded in this run's stream
+        _trace.note_step(self._step, dt, registry=self)
         if self.flush_interval and self._step % self.flush_interval == 0:
             self.flush()
 
@@ -490,7 +519,9 @@ class Registry:
         numbers pass through untouched.  This is the registry's single
         sync point (never inside the jitted step)."""
         arrays = []
-        for m in self._metrics.values():
+        # list(): atomic snapshot — a background thread (guard ckpt
+        # writer) may mint a new metric mid-iteration
+        for m in list(self._metrics.values()):
             for v in m._pending_values():
                 if hasattr(v, "dtype"):
                     arrays.append(v)
@@ -533,7 +564,7 @@ class Registry:
             if self.run_id:
                 meta["run"] = self.run_id
             records.append(meta)
-        for m in self._metrics.values():
+        for m in list(self._metrics.values()):
             m._resolve(resolve)
             rec = m._record(self._step)
             if rec is not None:
@@ -543,6 +574,8 @@ class Registry:
                             for k, v in ev["fields"].items()}
             records.append(ev)
         self._events = []
+        if records:
+            _trace.note_flush(self._step, records)
         if self.sink is not None and records and self._emit_allowed():
             self.sink.write(records)
         return records
@@ -559,7 +592,7 @@ class Registry:
             return {}
         resolve = self._resolver()
         out = {}
-        for name, m in self._metrics.items():
+        for name, m in list(self._metrics.items()):
             m._resolve(resolve)
             if isinstance(m, Counter):
                 out[name] = m.total

@@ -93,6 +93,15 @@ def summarize(records: List[dict]) -> dict:
         "collective_calls": counter_final("ddp.allreduce_calls"),
         "loader_queue_depth": gauge_last("loader.queue_depth"),
         "loader_wait_ms": hist("loader.wait_ms"),
+        # resilience lifecycle (docs/resilience.md): the guard emits
+        # these through the same registry, so a run that injected
+        # faults / rolled back / resumed shows it in the summary
+        # instead of silently dropping the events (PR-3 catch-up)
+        "faults_injected": len(events.get("fault_injected", ())),
+        "rollbacks": len(events.get("rollback", ())),
+        "resumes": len(events.get("resumed", ())),
+        "preemptions": len(events.get("preempted", ())),
+        "sentinel_fires": len(events.get("sentinel.slow_step", ())),
     }
     examples = counter_final("examples") or counter_final("tokens")
     if examples and step_time and step_time["sum"]:
@@ -131,6 +140,13 @@ def format_summary(s: dict) -> str:
         lines.append(f"  loader queue depth  {s['loader_queue_depth']:.0f}"
                      f" (last)")
     lines.append(f"  loader wait         {_fmt_hist(s['loader_wait_ms'])}")
+    res = [(k, s.get(k, 0)) for k in ("faults_injected", "rollbacks",
+                                      "resumes", "preemptions",
+                                      "sentinel_fires")]
+    if any(n for _, n in res):
+        lines.append("  resilience          "
+                     + "  ".join(f"{k.replace('_', ' ')} {n}"
+                                 for k, n in res if n))
     return "\n".join(lines)
 
 
@@ -218,7 +234,16 @@ def run_demo(path: str, steps: int = 6, overflow_at: int = 3,
 def main(argv=None) -> int:
     import argparse
     import os
+    import sys
     import tempfile
+
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "trace":
+        # `python -m apex_tpu.telemetry trace <file>`: the span-timeline
+        # summary (per-name count/total/p50/p99 self-time, pyprof-style)
+        from . import trace as _trace
+        return _trace.cli(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.telemetry",

@@ -31,6 +31,7 @@ import dataclasses
 import functools
 import gc
 import json
+import os
 import sys
 import time
 
@@ -156,6 +157,33 @@ def time_optax(make_params, grads, grad_dtype=None):
     ms = slope_time_ms(stepfn, state, params, grads)
     _log(f"optax baseline: {ms:.2f} ms/step")
     return ms
+
+
+def _leg_span(name):
+    """Span around one bench leg through the process-default tracer
+    (docs/telemetry.md tracing) — the no-op singleton when no tracer is
+    installed, so un-traced runs pay one attribute check per leg."""
+    from apex_tpu.telemetry import trace as _trace
+    return _trace.span("bench." + name)
+
+
+def _maybe_install_bench_tracer():
+    """``APEX_BENCH_TRACE=<path.json>`` installs a tracer for the run;
+    run_bench writes the leg/span timeline there on exit (loads in
+    Perfetto / ``python -m apex_tpu.telemetry trace``).  Returns
+    (tracer, path, previous_tracer) — the previous default is restored
+    on exit, never silently uninstalled."""
+    path = os.environ.get("APEX_BENCH_TRACE")
+    if not path:
+        return None, None, None
+    from apex_tpu.telemetry import trace as _trace
+    # enabled=True, not the APEX_TPU_TRACE env default: setting
+    # APEX_BENCH_TRACE is itself the opt-in, and an ambient
+    # APEX_TPU_TRACE=0 would otherwise spend the bench time writing an
+    # empty timeline
+    tracer = _trace.Tracer(enabled=True)
+    prev = _trace.set_tracer(tracer)
+    return tracer, path, prev
 
 
 def telemetry_summary(step_ms_samples, counters=None):
@@ -507,6 +535,26 @@ def _bench_bert_e2e_at(on_tpu, cfg, batch, seq):
 
 
 def run_bench(budget_left=lambda: 1e9, legs_dir=None):
+    """The bench with optional span tracing: ``APEX_BENCH_TRACE=<path>``
+    wraps every leg in a span and writes the Chrome-trace timeline on
+    exit — even when a leg dies, the completed legs' spans survive."""
+    tracer, trace_path, prev_tracer = _maybe_install_bench_tracer()
+    try:
+        return _run_bench(budget_left, legs_dir)
+    finally:
+        if tracer is not None:
+            from apex_tpu.telemetry import trace as _trace
+            _trace.set_tracer(prev_tracer)
+            try:
+                tracer.write(trace_path)
+                _log(f"bench span trace written: {trace_path}")
+            except OSError as err:
+                # a bad trace path must not mask the leg error that is
+                # propagating through this finally block
+                _log(f"bench span trace NOT written ({err!r})")
+
+
+def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
     from apex_tpu.utils.bench_legs import make_flusher
     flush = make_flusher(legs_dir)
 
@@ -531,31 +579,33 @@ def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     # destroy that window's already-captured timings (no flush before
     # the first measurement, for the same reason).
     head = {"n_params": n_params, "complete": False}
-    xla_ms = time_apex_xla(make_params, grads)
-    head["xla_impl_ms"] = round(xla_ms, 3)
-    flush("headline", head, merge=True)
-    fused_ms = time_apex_fused_flat(make_params, grads)
-    head["fused_flat_impl_ms"] = round(fused_ms, 3)
-    flush("headline", head, merge=True)
-    fused_bf16_ms = time_apex_fused_flat(make_params, grads,
-                                         grad_dtype=jnp.bfloat16)
-    head["fused_flat_bf16grads_ms"] = round(fused_bf16_ms, 3)
-    flush("headline", head, merge=True)
-    # bf16 grads AND bf16-stored moments: the narrowest flat step
-    # (18 B/param; state_dtype knob, r5)
-    fused_bf16s_ms = time_apex_fused_flat(make_params, grads,
-                                          grad_dtype=jnp.bfloat16,
-                                          state_dtype=jnp.bfloat16)
-    head["fused_flat_bf16state_ms"] = round(fused_bf16s_ms, 3)
-    flush("headline", head, merge=True)
-    base_ms = time_optax(make_params, grads)
-    head["optax_baseline_ms"] = round(base_ms, 3)
-    flush("headline", head, merge=True)
-    # dtype-matched baseline for the bf16-grads pair: optax fed the same
-    # bf16 gradients (r5: the 23.0 ms flat-bf16 measurement needs an
-    # apples-to-apples denominator, not the fp32 one)
-    base_bf16_ms = time_optax(make_params, grads, grad_dtype=jnp.bfloat16)
-    head["optax_bf16grads_ms"] = round(base_bf16_ms, 3)
+    with _leg_span("headline"):
+        xla_ms = time_apex_xla(make_params, grads)
+        head["xla_impl_ms"] = round(xla_ms, 3)
+        flush("headline", head, merge=True)
+        fused_ms = time_apex_fused_flat(make_params, grads)
+        head["fused_flat_impl_ms"] = round(fused_ms, 3)
+        flush("headline", head, merge=True)
+        fused_bf16_ms = time_apex_fused_flat(make_params, grads,
+                                             grad_dtype=jnp.bfloat16)
+        head["fused_flat_bf16grads_ms"] = round(fused_bf16_ms, 3)
+        flush("headline", head, merge=True)
+        # bf16 grads AND bf16-stored moments: the narrowest flat step
+        # (18 B/param; state_dtype knob, r5)
+        fused_bf16s_ms = time_apex_fused_flat(make_params, grads,
+                                              grad_dtype=jnp.bfloat16,
+                                              state_dtype=jnp.bfloat16)
+        head["fused_flat_bf16state_ms"] = round(fused_bf16s_ms, 3)
+        flush("headline", head, merge=True)
+        base_ms = time_optax(make_params, grads)
+        head["optax_baseline_ms"] = round(base_ms, 3)
+        flush("headline", head, merge=True)
+        # dtype-matched baseline for the bf16-grads pair: optax fed the
+        # same bf16 gradients (r5: the 23.0 ms flat-bf16 measurement
+        # needs an apples-to-apples denominator, not the fp32 one)
+        base_bf16_ms = time_optax(make_params, grads,
+                                  grad_dtype=jnp.bfloat16)
+        head["optax_bf16grads_ms"] = round(base_bf16_ms, 3)
     del grads
     gc.collect()
     # `value`/`vs_baseline` are best-vs-best across dtype-matched pairs:
@@ -590,7 +640,8 @@ def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     rn50_key = "rn50" if on_tpu else "rn50_cpu_standin_resnet18"
     if budget_left() > 100:
         try:
-            detail[rn50_key] = bench_rn50(on_tpu)
+            with _leg_span(rn50_key):
+                detail[rn50_key] = bench_rn50(on_tpu)
         except Exception as err:
             detail[rn50_key] = {"error": repr(err)[:200]}
         flush(rn50_key, detail[rn50_key])
@@ -603,7 +654,8 @@ def run_bench(budget_left=lambda: 1e9, legs_dir=None):
             and "images_per_sec" in detail[rn50_key]:
         try:
             ours = detail[rn50_key]
-            base = bench_rn50_native_baseline(on_tpu, ours["batch"])
+            with _leg_span("rn50_native_baseline"):
+                base = bench_rn50_native_baseline(on_tpu, ours["batch"])
             ours["native_optax_baseline"] = base
             ours["vs_native_baseline"] = round(
                 ours["images_per_sec"] / base["images_per_sec"], 3)
@@ -614,7 +666,8 @@ def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     gc.collect()
     if budget_left() > 100:
         try:
-            detail["bert_e2e"] = bench_bert_e2e(on_tpu)
+            with _leg_span("bert_e2e"):
+                detail["bert_e2e"] = bench_bert_e2e(on_tpu)
         except Exception as err:
             detail["bert_e2e"] = {"error": repr(err)[:200]}
         flush("bert_e2e", detail["bert_e2e"])
@@ -625,7 +678,8 @@ def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     # nothing about the remat trade)
     if on_tpu and budget_left() > 120:
         try:
-            detail["bert_e2e_max"] = bench_bert_max(on_tpu)
+            with _leg_span("bert_e2e_max"):
+                detail["bert_e2e_max"] = bench_bert_max(on_tpu)
         except Exception as err:
             detail["bert_e2e_max"] = {"error": repr(err)[:200]}
         flush("bert_e2e_max", detail["bert_e2e_max"])

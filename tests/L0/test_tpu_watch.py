@@ -277,6 +277,57 @@ def test_smoke_runs_first_then_stages_proceed(tmp_path):
     assert (tmp_path / "TUNNEL_LIVE").exists()
 
 
+def test_stage_spans_written_and_renderable(tmp_path):
+    """Every capture stage appends one chrome-trace span to the
+    WATCH_TRACE streaming array (crash-safe: never closed), and
+    ``python -m apex_tpu.telemetry trace`` renders the per-stage
+    summary from it."""
+    import sys
+    r, log = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_TRAIN_CMD": "echo 'Step 1 Loss 2.0'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    trace_file = tmp_path / "WATCH_TRACE_r5.json"
+    assert trace_file.exists()
+    from apex_tpu.telemetry import trace as ttrace
+    evs = ttrace.load_chrome(str(trace_file))
+    names = [e["name"] for e in evs]
+    # one span per executed stage, in execution order
+    assert names[:3] == ["watch.smoke", "watch.bench_kernels",
+                         "watch.bench"]
+    assert "watch.train" in names and "watch.apply" in names
+    assert all(e["args"]["rc"] == 0 for e in evs
+               if e["name"] in ("watch.smoke", "watch.bench"))
+    rcli = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.telemetry", "trace",
+         str(trace_file)],
+        capture_output=True, text=True, cwd=ROOT, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    assert rcli.returncode == 0, rcli.stderr[-2000:]
+    assert "span timeline summary" in rcli.stdout
+    assert "watch.bench" in rcli.stdout
+
+
+def test_stage_spans_record_failures_too(tmp_path):
+    """A failing stage's span carries its rc — the timeline shows WHERE
+    a window died, which is the whole point of the stage spans."""
+    r, log = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_SMOKE_CMD": "echo smoke-broken; false",
+        "APEX_WATCH_BENCH_CMD": "true",
+        "APEX_WATCH_KERN_CMD": "true",
+    })
+    assert r.returncode == 1
+    from apex_tpu.telemetry import trace as ttrace
+    evs = ttrace.load_chrome(str(tmp_path / "WATCH_TRACE_r5.json"))
+    smokes = [e for e in evs if e["name"] == "watch.smoke"]
+    assert len(smokes) == 5                    # one per probed window
+    assert all(e["args"]["rc"] == 1 for e in smokes)
+
+
 def test_wedged_probe_keeps_probing_then_gives_up(tmp_path):
     r, log = run_watch(tmp_path, {
         "APEX_WATCH_PROBE_CMD": "echo 'probe timeout (tunnel wedged)'; false",

@@ -91,6 +91,22 @@ INTEROP_TO=${APEX_WATCH_INTEROP_TO:-600}
 BENCH_TO=${APEX_WATCH_BENCH_TO:-800}
 KERN_TO=${APEX_WATCH_KERN_TO:-860}
 
+# stage span timeline: every capture stage appends one chrome-trace
+# complete event to WATCH_TRACE as a STREAMING JSON array (opened with
+# '[', never closed — the Trace Event Format explicitly allows it, and
+# a watcher killed mid-window must still leave every finished stage's
+# span on disk).  Render with
+#   python -m apex_tpu.telemetry trace "$WATCH_TRACE"
+# or load it directly in chrome://tracing / Perfetto.
+WATCH_TRACE=${APEX_WATCH_TRACE:-WATCH_TRACE_r5.json}
+now_us() { echo $(( $(date +%s%N) / 1000 )); }
+stage_span() {  # $1: stage name, $2: t0 (us), $3: rc
+  local t1; t1=$(now_us)
+  [ -s "$WATCH_TRACE" ] || printf '[\n' > "$WATCH_TRACE"
+  printf '{"name":"watch.%s","cat":"stage","ph":"X","ts":%s,"dur":%s,"pid":1,"tid":1,"args":{"rc":%s}},\n' \
+    "$1" "$2" $(( t1 - $2 )) "${3:-0}" >> "$WATCH_TRACE"
+}
+
 # complete/bench_complete parse the JSON and check TOP-LEVEL fields: a
 # whole-file grep would match the '"backend": "tpu"' embedded in a CPU
 # fallback's tpu_partial_legs records and credit a CPU artifact as a
@@ -124,8 +140,10 @@ for i in $(seq 1 "$N_PROBES"); do
     echo "$(date +%H:%M:%S) tunnel healthy — running capture stages (legs incremental)" >> "$LOG"
     # ---- stage 0: Pallas kernel smoke (compile + numerics gate) ----
     if [ -n "$SMOKE_CMD" ]; then
+      t0=$(now_us)
       timeout -k 10 "$SMOKE_TO" bash -c "$SMOKE_CMD" >> "$LOG" 2>&1
       rc0=$?
+      stage_span smoke "$t0" "$rc0"
       echo "$(date +%H:%M:%S) tpu_smoke done rc=$rc0" >> "$LOG"
       if [ $rc0 -ne 0 ]; then
         echo "$(date +%H:%M:%S) tpu_smoke FAILED; kernels unusable on this chip/toolchain — resuming probe loop" >> "$LOG"
@@ -138,8 +156,10 @@ for i in $(seq 1 "$N_PROBES"); do
       echo "$(date +%H:%M:%S) bench_kernels.py already complete; skipping" >> "$LOG"
     else
       # -k 10: a client hung in the C++ dial ignores SIGTERM; follow with KILL
+      t0=$(now_us)
       timeout -k 10 "$KERN_TO" bash -c "$KERN_CMD" > "$KERN_JSON" 2>> "$LOG"
       rc1=$?
+      stage_span bench_kernels "$t0" "$rc1"
       echo "$(date +%H:%M:%S) bench_kernels.py done rc=$rc1" >> "$LOG"
       if [ $rc1 -ne 0 ] || [ ! -s "$KERN_JSON" ]; then
         bash -c "$ASSEMBLE_CMD $KERN_LEGS --kind kernels" > "$KERN_JSON" 2>> "$LOG"
@@ -160,8 +180,10 @@ for i in $(seq 1 "$N_PROBES"); do
     if bench_complete; then
       echo "$(date +%H:%M:%S) bench.py already complete (incl. extras); skipping" >> "$LOG"
     else
+      t0=$(now_us)
       timeout -k 10 "$BENCH_TO" bash -c "$BENCH_CMD" > "$BENCH_JSON".run 2>> "$LOG"
       rc3=$?
+      stage_span bench "$t0" "$rc3"
       echo "$(date +%H:%M:%S) bench.py done rc=$rc3" >> "$LOG"
       if [ $rc3 -eq 0 ] && complete "$BENCH_JSON".run; then
         mv "$BENCH_JSON".run "$BENCH_JSON"
@@ -184,8 +206,10 @@ for i in $(seq 1 "$N_PROBES"); do
     # incremental progress in ANY window length, so it must never be
     # starved by a long stage that needs a full window to pay off
     if [ -n "$GTRAIN_CMD" ] && [ ! -s "$GTRAIN_DONE" ]; then
+      t0=$(now_us)
       timeout -k 10 "$GTRAIN_TO" bash -c "$GTRAIN_CMD" >> "$GTRAIN_LOG" 2>&1
       rcg=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span guard_train "$t0" "$rcg"
       echo "$(date +%H:%M:%S) guard train leg done rc=$rcg" >> "$LOG"
       if [ $rcg -eq 0 ]; then
         date -u +%Y-%m-%dT%H:%M:%SZ > "$GTRAIN_DONE"
@@ -201,8 +225,10 @@ for i in $(seq 1 "$N_PROBES"); do
     # run that hangs on a re-wedge must not starve the bench captures
     # across short flap windows (code-review r5)
     if [ -n "$TRAIN_CMD" ] && [ ! -s "$TRAIN_LOG" ]; then
+      t0=$(now_us)
       timeout -k 10 "$TRAIN_TO" bash -c "$TRAIN_CMD" > "$TRAIN_LOG" 2>&1
       rc2=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span train "$t0" "$rc2"
       echo "$(date +%H:%M:%S) train run (save+resume) done rc=$rc2" >> "$LOG"
       if [ $rc2 -ne 0 ]; then
         # a failed/partial train log must not be mistaken for a pass,
@@ -215,13 +241,17 @@ for i in $(seq 1 "$N_PROBES"); do
       fi
     fi
     # ---- stage 4: flip defaults to measured winners (best-effort) ----
+    t0=$(now_us)
     bash -c "$APPLY_CMD" >> "$LOG" 2>&1
     rc_apply=$?
+    stage_span apply "$t0" "$rc_apply"
     echo "$(date +%H:%M:%S) apply_perf_results done rc=$rc_apply" >> "$LOG"
     # ---- stage 5: interop bridge cost (best-effort; CPU-side meas.) ----
     if [ -n "$INTEROP_CMD" ] && [ ! -s "$INTEROP_JSON" ]; then
+      t0=$(now_us)
       timeout -k 10 "$INTEROP_TO" bash -c "$INTEROP_CMD" > "$INTEROP_JSON" 2>> "$LOG"
       rc5=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span interop "$t0" "$rc5"
       echo "$(date +%H:%M:%S) interop bench done rc=$rc5" >> "$LOG"
     fi
     # marker LAST: it invites the interactive session to kill this script
