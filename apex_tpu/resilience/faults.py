@@ -38,6 +38,11 @@ Fault kinds and their consumers:
   * ``collective_fail`` — :func:`wrap_collective` raises
     :class:`CollectiveFault` on the scheduled *call index* (collectives
     fire at trace time under jit, so the index counts wrapper calls).
+  * ``oom`` — the guard raises a synthetic ``RESOURCE_EXHAUSTED``
+    allocator failure (``telemetry.memory.synthetic_oom``, message
+    shaped like a real XLA report) at the scheduled step, driving the
+    OOM post-mortem path: flight-oom dump, then RE-RAISE — an OOM is
+    deterministic, so the guard never burns rollback retries on it.
 
 The module imports neither jax nor the package root at import time, so
 instrumented library code (the data loader) can probe for an active
@@ -51,7 +56,7 @@ import re
 import time
 from typing import List, Optional, Tuple
 
-KINDS = ("nan", "inf", "preempt", "loader_stall", "collective_fail")
+KINDS = ("nan", "inf", "preempt", "loader_stall", "collective_fail", "oom")
 _ALIASES = {"nan_grads": "nan", "inf_grads": "inf", "sigterm": "preempt"}
 
 _ENTRY = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"

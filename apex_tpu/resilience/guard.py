@@ -245,18 +245,23 @@ class TrainGuard:
         from ..telemetry import trace as _trace
         _trace.note_event(name, step=fields.get("step"), fields=fields)
 
+    def _flight_destination(self, recorder_directory):
+        """The ONE dump-directory chain both flight paths share:
+        ``cfg.flight_dir`` > the recorder's own directory > next to the
+        checkpoints."""
+        return (self.cfg.flight_dir or recorder_directory
+                or (self.manager.directory if self.manager else None))
+
     def _dump_flight(self, reason: str, step: int, **fields):
         """Dump the flight recorder on a guard lifecycle failure
         (rollback / preempt / unhandled exception).  Destination:
-        ``cfg.flight_dir`` > the tracer's own directory > next to the
-        checkpoints.  Best-effort — a failed dump never fails the run.
-        Returns the written path (or None)."""
+        :meth:`_flight_destination`.  Best-effort — a failed dump never
+        fails the run.  Returns the written path (or None)."""
         from ..telemetry import trace as _trace
         tr = _trace.get_tracer()
         if tr is None or not tr.enabled:
             return None
-        directory = (self.cfg.flight_dir or tr.recorder.directory
-                     or (self.manager.directory if self.manager else None))
+        directory = self._flight_destination(tr.recorder.directory)
         if directory is None:
             return None
         try:
@@ -265,6 +270,32 @@ class TrainGuard:
         except Exception:   # disk full, or an off-schema ring entry —
             return None     # a failed dump must never mask the real
                             # error propagating through run()
+
+    def _dump_oom(self, step: int, exc: BaseException):
+        """The OOM post-mortem (``flight-oom-<ts>.json``): allocator
+        report parsed from the error, the registry monitor's
+        live-memory history, the registered static attribution, and the
+        flight ring — written even when no tracer is installed (a
+        crash artifact must not depend on tracing being on).
+        Best-effort like :meth:`_dump_flight`; the OOM always
+        re-raises either way."""
+        from ..telemetry import memory as _tmem
+        from ..telemetry import trace as _trace
+        tr = _trace.get_tracer()
+        recorder = tr.recorder if (tr is not None and tr.enabled) else None
+        directory = self._flight_destination(
+            recorder.directory if recorder is not None else None)
+        if directory is None:
+            return None
+        reg = self._registry
+        if reg is None:
+            from ..telemetry import events as _events
+            reg = _events.get_default()
+        try:
+            return _tmem.dump_oom(recorder, step=step, error=exc,
+                                  directory=directory, registry=reg)
+        except Exception:
+            return None
 
     # -- state <-> host ------------------------------------------------------
     def _snapshot(self, state, step: int) -> dict:
@@ -421,6 +452,16 @@ class TrainGuard:
                     signal.raise_signal(signal.SIGTERM)
                 if self._stop:
                     break
+                if plan is not None and plan.fire("oom", step) is not None:
+                    # deterministic allocator exhaustion: the raise
+                    # rides the normal exception path below, which
+                    # recognizes OOM, writes the post-mortem, and
+                    # re-raises — never a rollback (an OOM replays
+                    # identically; retries would only burn the budget)
+                    report.faults_injected += 1
+                    self._emit("fault_injected", kind="oom", step=step)
+                    from ..telemetry import memory as _tmem
+                    raise _tmem.synthetic_oom(step)
                 batch = batches(step) if seekable else next(it)
                 if plan is not None:
                     for kind in ("nan", "inf"):
@@ -474,9 +515,17 @@ class TrainGuard:
         except BaseException as e:
             # the crash flight recorder: whatever ran in the seconds
             # before an unhandled error (GuardAbort included) is written
-            # out before the exception propagates
-            self._dump_flight("exception", step, error=repr(e)[:200],
-                              error_type=type(e).__name__)
+            # out before the exception propagates.  An OOM (injected or
+            # a real RESOURCE_EXHAUSTED) gets the richer post-mortem —
+            # allocator report + live-memory history + static
+            # attribution — instead of the generic dump
+            from ..telemetry import memory as _tmem
+            if _tmem.is_oom_error(e):
+                self._emit("memory.oom", step=step, error=repr(e)[:200])
+                self._dump_oom(step, e)
+            else:
+                self._dump_flight("exception", step, error=repr(e)[:200],
+                                  error_type=type(e).__name__)
             raise
         finally:
             if writer is not None:

@@ -1,6 +1,6 @@
 """apex_tpu.telemetry — training-telemetry subsystem.
 
-Five pieces (see docs/telemetry.md):
+Six pieces (see docs/telemetry.md):
 
   * :mod:`registry`  — counters/gauges/histograms/meters with a
     host-sync-batching ``step()`` context, rank-0-gated JSONL emission
@@ -16,9 +16,15 @@ Five pieces (see docs/telemetry.md):
   * :mod:`attrib`    — per-op FLOPs/bytes attribution over the compiled
     HLO (the per-fusion refinement of ``pyprof.prof.cost_report``),
     with blas/conv/pointwise/reduction/collective op-class rollups;
+  * :mod:`memory`    — peak-HBM attribution from ``memory_analysis()``
+    + an HLO liveness sweep (``memory_table``/``memory_model``), live
+    ``device.memory_stats`` gauges polled at registry-flush cadence
+    (Chrome counter tracks under the span rows), and the OOM
+    post-mortem (``flight-oom-*.json``) the resilience guard writes on
+    ``RESOURCE_EXHAUSTED``;
   * :mod:`report`    — JSONL → step-metrics summary +
     ``python -m apex_tpu.telemetry`` CLI (``trace <file>`` renders the
-    span-timeline summary).
+    span-timeline summary, ``mem`` the peak-HBM table).
 
 The reference has no counterpart: its observability is rank-0 prints
 and an ``AverageMeter`` whose docstring warns that printing costs an
@@ -31,6 +37,7 @@ accounting before it can claim a win.
 from . import trace
 from . import registry
 from . import events
+from . import memory
 from .registry import (SCHEMA, Registry, Counter, Gauge, Histogram,
                        AverageMeter, Throughput, JsonlSink, MemorySink,
                        NULL_METRIC, record_violations, records_violations)
@@ -39,13 +46,18 @@ from .events import (set_default, get_default, active, observe_scaler,
                      record_ckpt)
 from .trace import (Tracer, FlightRecorder, SlowStepSentinel, NULL_SPAN,
                     set_tracer, get_tracer, span, traced)
+from .memory import (MemoryMonitor, memory_table, memory_model,
+                     format_memory_table)
 
 __all__ = [
-    "trace", "registry", "events", "SCHEMA", "Registry", "Counter", "Gauge",
+    "trace", "registry", "events", "memory", "SCHEMA", "Registry",
+    "Counter", "Gauge",
     "Histogram", "AverageMeter", "Throughput", "JsonlSink", "MemorySink",
     "NULL_METRIC", "record_violations", "records_violations",
     "set_default", "get_default", "active", "observe_scaler",
     "observe_amp", "record_collective", "record_loader", "record_ckpt",
     "Tracer", "FlightRecorder", "SlowStepSentinel", "NULL_SPAN",
     "set_tracer", "get_tracer", "span", "traced",
+    "MemoryMonitor", "memory_table", "memory_model",
+    "format_memory_table",
 ]

@@ -43,6 +43,12 @@ except ImportError:         # standalone file-based load: tools/
         note_event = staticmethod(lambda *a, **k: None)
         note_flush = staticmethod(lambda *a, **k: None)
         note_step = staticmethod(lambda *a, **k: None)
+        note_counter = staticmethod(lambda *a, **k: None)
+
+try:                        # the memory monitor rides the same shim
+    from . import memory as _memory    # rule: the standalone load only
+except ImportError:                    # audits SCHEMA, never flushes
+    _memory = None
 
 # ---------------------------------------------------------------------------
 # record schema (the committed JSONL contract)
@@ -431,9 +437,20 @@ class Registry:
 
     def __init__(self, *, sink=None, enabled: Optional[bool] = None,
                  flush_interval: int = 1, rank0_only: bool = True,
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None, memory=None):
         self.enabled = _env_enabled() if enabled is None else bool(enabled)
         self.sink = sink
+        # live-memory gauges (docs/telemetry.md Memory): ``memory`` is a
+        # telemetry.memory.MemoryMonitor, None for the env-gated default
+        # (APEX_TPU_TELEMETRY_MEM), or False to switch polling off.  A
+        # disabled/absent monitor costs one attribute check per flush;
+        # a backend without allocator stats costs one probe, ever.
+        if (not self.enabled or memory is False or
+                (memory is None and _memory is None)):
+            self._memory = None
+        else:
+            mon = memory if memory is not None else _memory.MemoryMonitor()
+            self._memory = mon if mon.enabled else None
         self.flush_interval = int(flush_interval)
         self.rank0_only = rank0_only
         self.run_id = run_id
@@ -555,6 +572,11 @@ class Registry:
         in-process consumers (benches) can embed them."""
         if not self.enabled:
             return []
+        if self._memory is not None:
+            # part of the flush's batched host window: one allocator
+            # read -> mem.* gauges (resolved just below, they are
+            # plain floats) + the tracer's device_mem counter track
+            self._memory.observe_flush(self)
         resolve = self._resolver()
         records: List[dict] = []
         if not self._wrote_meta:

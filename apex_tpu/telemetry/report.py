@@ -78,11 +78,19 @@ def summarize(records: List[dict]) -> dict:
         recs = [r for r in metrics.get(name, ()) if r["type"] == "gauge"]
         return recs[-1]["value"] if recs else None
 
+    def gauge_max(name):
+        vals = [r["value"] for r in metrics.get(name, ())
+                if r["type"] == "gauge"]
+        return max(vals) if vals else None
+
     def hist(name):
         return _combine_hist([r for r in metrics.get(name, ())
                               if r["type"] == "histogram"])
 
     step_time = hist("step_time_ms")
+    mem_peak = gauge_max("mem.peak_bytes_in_use")
+    if mem_peak is None:
+        mem_peak = gauge_max("mem.compiled_peak_bytes")
     out = {
         "steps": steps,
         "step_time_ms": step_time,
@@ -102,6 +110,14 @@ def summarize(records: List[dict]) -> dict:
         "resumes": len(events.get("resumed", ())),
         "preemptions": len(events.get("preempted", ())),
         "sentinel_fires": len(events.get("sentinel.slow_step", ())),
+        # memory (docs/telemetry.md Memory): live allocator high-water
+        # from the monitor's mem.* gauges (max over the run — a gauge's
+        # last value would under-report a mid-run spike), the
+        # compiled-model peak bench legs embed, and the guard's OOM
+        # post-mortem events
+        "mem_peak_bytes": mem_peak,
+        "mem_in_use_bytes": gauge_last("mem.bytes_in_use"),
+        "oom_events": len(events.get("memory.oom", ())),
     }
     examples = counter_final("examples") or counter_final("tokens")
     if examples and step_time and step_time["sum"]:
@@ -147,6 +163,15 @@ def format_summary(s: dict) -> str:
         lines.append("  resilience          "
                      + "  ".join(f"{k.replace('_', ' ')} {n}"
                                  for k, n in res if n))
+    if s.get("mem_peak_bytes") is not None or s.get("oom_events"):
+        from .memory import _human as _hb
+        parts = []
+        if s.get("mem_peak_bytes") is not None:
+            parts.append(f"peak {_hb(s['mem_peak_bytes'], 'B')}")
+        if s.get("mem_in_use_bytes") is not None:
+            parts.append(f"in-use {_hb(s['mem_in_use_bytes'], 'B')}")
+        parts.append(f"oom events {s.get('oom_events', 0)}")
+        lines.append("  memory              " + "  ".join(parts))
     return "\n".join(lines)
 
 
@@ -244,6 +269,12 @@ def main(argv=None) -> int:
         # summary (per-name count/total/p50/p99 self-time, pyprof-style)
         from . import trace as _trace
         return _trace.cli(argv[1:])
+    if argv and argv[0] == "mem":
+        # `python -m apex_tpu.telemetry mem [artifact]`: the per-class
+        # peak-HBM attribution table (flagship step, bench artifact, or
+        # a flight-oom post-mortem)
+        from . import memory as _memory
+        return _memory.cli(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.telemetry",

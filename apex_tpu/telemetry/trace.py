@@ -50,7 +50,7 @@ from typing import Any, Dict, List, Optional
 __all__ = [
     "Tracer", "FlightRecorder", "SlowStepSentinel", "NULL_SPAN",
     "set_tracer", "get_tracer", "active", "span", "traced",
-    "note_span", "note_event", "note_flush", "note_step",
+    "note_span", "note_event", "note_flush", "note_step", "note_counter",
     "load_chrome", "span_summary", "format_span_summary",
     "dump_violations", "cli",
 ]
@@ -177,12 +177,15 @@ class FlightRecorder:
 
     def dump(self, reason: str, *, step: Optional[int] = None,
              directory: Optional[str] = None, path: Optional[str] = None,
-             fields: Optional[dict] = None) -> Optional[str]:
+             fields: Optional[dict] = None,
+             sections: Optional[dict] = None) -> Optional[str]:
         """Write the ring to ``path`` (or a timestamped
         ``flight-<reason>-<ts>.json`` under ``directory`` /
         ``self.directory``).  Returns the written path, or None when no
         destination is configured — a recorder without a home must not
-        litter the cwd."""
+        litter the cwd.  ``sections`` adds whole top-level documents to
+        the dump (the OOM post-mortem's ``oom`` section) — callers own
+        their section's schema; the core keys cannot be clobbered."""
         entries = self.snapshot()
         doc = {
             "kind": "flight_recorder",
@@ -196,6 +199,9 @@ class FlightRecorder:
             "total_recorded": self.total,
             "entries": entries,
         }
+        for key, value in (sections or {}).items():
+            if key not in doc:
+                doc[key] = value
         if path is None:
             d = directory or self.directory
             if d is None:
@@ -217,7 +223,7 @@ class FlightRecorder:
         return path
 
 
-ENTRY_KINDS = ("span", "instant", "event", "metric_flush")
+ENTRY_KINDS = ("span", "instant", "event", "metric_flush", "counter")
 
 _is_str = lambda v: isinstance(v, str)
 _is_num = lambda v: isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -262,6 +268,12 @@ def dump_violations(doc: Any) -> List[str]:
             out.append(f"entry[{i}]: span needs numeric t_us/dur_us")
         if k == "metric_flush" and not _is_int(e.get("n_records")):
             out.append(f"entry[{i}]: metric_flush needs n_records")
+        if k == "counter":
+            vals = e.get("values")
+            if not (isinstance(vals, dict)
+                    and all(_is_num(v) for v in vals.values())):
+                out.append(f"entry[{i}]: counter needs a numeric "
+                           f"values dict")
     return out
 
 
@@ -488,6 +500,29 @@ class Tracer:
         self._record(name, t1 - dur_ns if t0_ns is None else t0_ns,
                      dur_ns, attrs)
 
+    def counter(self, name: str, step: Optional[int] = None,
+                **values) -> None:
+        """Record a Chrome counter sample (``ph: "C"``) — Perfetto
+        renders one numeric track per ``values`` key under the span
+        rows (the live-memory curve).  Non-numeric values are dropped
+        rather than corrupting the track."""
+        if not self.enabled:
+            return
+        vals = {str(k): float(v) for k, v in values.items()
+                if isinstance(v, (int, float))
+                and not isinstance(v, bool)}
+        if not vals:
+            return
+        ev = {"ph": "C", "name": name,
+              "ts": time.perf_counter_ns() / 1e3,
+              "pid": self._pid, "args": vals}
+        with self._lock:
+            self._append(ev)
+        rec = {"kind": "counter", "name": name, "values": vals}
+        if step is not None:
+            rec["step"] = int(step)
+        self.recorder.record(rec)
+
     def instant(self, name: str, **attrs) -> None:
         """Record a zero-duration instant event (chrome ``ph: "i"``)."""
         if not self.enabled:
@@ -659,6 +694,16 @@ def note_flush(step: int, records: List[dict]) -> None:
     if tr is None or not tr.enabled:
         return
     tr.note_flush(step, records)
+
+
+def note_counter(name: str, step: Optional[int] = None,
+                 values: Optional[dict] = None) -> None:
+    """Counter-track sample into the default tracer (no-op when none)
+    — the memory monitor's flush hook."""
+    tr = _default
+    if tr is None or not tr.enabled or not values:
+        return
+    tr.counter(name, step=step, **values)
 
 
 def note_step(step: int, seconds: float, registry=None) -> None:

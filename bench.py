@@ -68,7 +68,7 @@ def slope_time_ms(stepfn, state, params, grads, n1=3, n2=13):
     return (t2 - t1) / (n2 - n1) * 1e3
 
 
-def time_apex_xla(make_params, grads):
+def time_apex_xla(make_params, grads, fields=None):
     opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=1.0, impl="xla")
     params = make_params()
     state = opt.init(params)
@@ -80,6 +80,19 @@ def time_apex_xla(make_params, grads):
     _log("timing FusedLAMB impl=xla ...")
     ms = slope_time_ms(stepfn, state, params, grads)
     _log(f"FusedLAMB impl=xla: {ms:.2f} ms/step")
+    if fields is not None:
+        # the headline leg's MFU/peak-HBM evidence, measured on the
+        # representative xla step (same params/grads shapes as every
+        # other headline impl).  analytic fallback: the r5 capture
+        # backend returned no flops keys from cost_analysis, and the
+        # perf-field audit would then flag the leg forever
+        on_tpu = jax.default_backend() == "tpu"
+        n = sum(int(g.size) for g in jax.tree_util.tree_leaves(grads))
+        fields.update(_roofline(stepfn, (state, grads, params),
+                                ms / 1e3, on_tpu,
+                                analytic_flops=_LAMB_STEP_FLOPS_PER_PARAM
+                                * n))
+        fields.update(_mem_fields(stepfn, (state, grads, params)))
     return ms
 
 
@@ -186,28 +199,93 @@ def _maybe_install_bench_tracer():
     return tracer, path, prev
 
 
-def telemetry_summary(step_ms_samples, counters=None):
+def telemetry_summary(step_ms_samples, counters=None, gauges=None):
     """Schema-valid telemetry block for a bench leg: the leg's measured
     step times flow through the REAL registry (so the records match the
     committed ``telemetry.SCHEMA`` exactly — test_bench_legs asserts it)
     and the rendered summary rides next to the raw records.
 
     ``counters``: extra cumulative counters, e.g. {"examples": total}.
+    ``gauges``: point-in-time values (the leg's MFU / peak-HBM fields:
+    ``mfu_pct``, ``mem.compiled_peak_bytes``, ...); None values are
+    skipped so legs can pass through optional fields unguarded.
     Returns ``{"records": [...], "summary": {...}}``.
     """
     from apex_tpu import telemetry
     from apex_tpu.telemetry import report as _treport
     sink = telemetry.MemorySink()
+    # memory=False: this registry carries the leg's EXPLICIT evidence —
+    # the default monitor's flush-time allocator poll would overwrite
+    # the mem.* gauges captured at measurement time
     reg = telemetry.Registry(sink=sink, flush_interval=0, rank0_only=False,
-                             run_id="bench")
+                             run_id="bench", memory=False)
     h = reg.histogram("step_time_ms")
     for ms in step_ms_samples:
         h.observe(float(ms))
     for name, total in (counters or {}).items():
         reg.counter(name).add(float(total))
+    for name, value in (gauges or {}).items():
+        if value is not None:
+            reg.gauge(name).set(float(value))
     reg.flush()
     return {"records": sink.records,
             "summary": _treport.summarize(sink.records)}
+
+
+def leg_telemetry(step_ms_samples, fields, counters=None):
+    """The per-leg telemetry block with the leg's MFU + peak-HBM
+    evidence lifted into schema-valid gauges, so
+    ``tools/apply_perf_results.py``'s audit (and any downstream reader)
+    sees them in ONE format whether it reads the leg dict or the
+    records (VERDICT round-5: 'no MFU/HBM fields landed in the
+    captured legs')."""
+    gauges = {}
+    mfu = fields.get("mfu_pct", fields.get("mfu_analytic_pct"))
+    if mfu is not None:
+        gauges["mfu_pct"] = mfu
+    for src, dst in (("hbm_compiled_peak_bytes", "mem.compiled_peak_bytes"),
+                     ("hbm_device_process_peak_bytes",
+                      "mem.peak_bytes_in_use"),
+                     ("hbm_device_in_use_bytes", "mem.bytes_in_use")):
+        if fields.get(src) is not None:
+            gauges[dst] = fields[src]
+    return telemetry_summary(step_ms_samples, counters=counters,
+                             gauges=gauges)
+
+
+def _mem_fields(jitted, args):
+    """Peak-HBM fields for a timed leg (ISSUE 6 satellite).  On TPU:
+    the device allocator's live/peak counters — one free host call, no
+    compile.  Off-TPU (CPU runs, tier-1): the compiled executable's
+    ``memory_analysis()`` footprint, which costs a cheap CPU compile.
+    The compiled path is deliberately NOT taken on TPU: like
+    ``_roofline``'s comment says, ``lower().compile()`` bypasses the
+    jit executable cache, and re-paying a bert-24L Mosaic compile after
+    the timing could blow the leg past BENCH_TO in a scarce tunnel
+    window.  Best-effort: a failure records itself, never kills the
+    leg."""
+    out = {}
+    try:
+        from apex_tpu.telemetry import memory as _tmem
+        live = _tmem.device_memory_stats()
+        if live:
+            out["hbm_device_in_use_bytes"] = live.get("bytes_in_use")
+            # the allocator high-water is PROCESS-lifetime (never reset
+            # between legs): a small leg after a big one reads the big
+            # leg's peak — the key says so, so no reader can mistake it
+            # for a per-leg footprint
+            out["hbm_device_process_peak_bytes"] = live.get(
+                "peak_bytes_in_use")
+        if jax.default_backend() != "tpu":
+            stats = _tmem.compiled_memory_stats(jitted, *args)
+            if stats:
+                out["hbm_compiled_peak_bytes"] = stats["peak_bytes"]
+                out["hbm_args_bytes"] = stats["argument_bytes"]
+                out["hbm_temp_bytes"] = stats["temp_bytes"]
+                out["hbm_output_bytes"] = stats["output_bytes"]
+    except Exception as err:
+        out["mem_error"] = repr(err)[:120]
+    return out
 
 
 # v5e single-chip roofline — single-sourced from the pyprof roofline
@@ -334,6 +412,9 @@ def _bench_rn50_at(on_tpu, batch):
     out.update(_roofline(train_step, (state, bn_state, images, labels),
                          step_s, on_tpu,
                          analytic_flops=_RN50_TRAIN_FLOPS_PER_IMAGE * batch))
+    out.update(_mem_fields(train_step, (state, bn_state, images, labels)))
+    out["telemetry"] = leg_telemetry([step_s * 1e3], out,
+                                     counters={"examples": batch})
     return out
 
 
@@ -341,6 +422,12 @@ def _bench_rn50_at(on_tpu, batch):
 # (bwd ~2x fwd) — the standard analytic count, used only when XLA's
 # cost_analysis yields nothing (labelled mfu_analytic_pct)
 _RN50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
+
+# FusedLAMB xla step, order-of-magnitude elementwise count per param:
+# grad global-norm (~2), m/v moment updates (~5), bias-corrected update
+# + weight decay (~7), per-layer param/update norms + trust ratio (~6)
+# — same analytic-fallback role as the rn50 constant above
+_LAMB_STEP_FLOPS_PER_PARAM = 20
 
 
 def bench_rn50_native_baseline(on_tpu, batch):
@@ -403,8 +490,11 @@ def bench_rn50_native_baseline(on_tpu, batch):
     step_s = (t2 - t1) / 6
     ips = batch / step_s
     _log(f"rn50 baseline: {step_s*1e3:.1f} ms/step, {ips:.1f} images/sec")
-    return {"images_per_sec": round(ips, 1), "batch": batch,
-            "step_ms": round(step_s * 1e3, 2)}
+    out = {"images_per_sec": round(ips, 1), "batch": batch,
+           "step_ms": round(step_s * 1e3, 2)}
+    out.update(_mem_fields(train_step,
+                           (params, opt_state, bn_state, images, labels)))
+    return out
 
 
 def bench_bert_e2e(on_tpu):
@@ -521,16 +611,19 @@ def _bench_bert_e2e_at(on_tpu, cfg, batch, seq):
            "model": ("bert-large-24L-flash-remat" if on_tpu
                      else "bert-tiny-cpu"),
            "n_params": n_params}
-    # the leg embeds its step timing as schema-valid telemetry records
-    # (docs/telemetry.md): tpu_watch.sh / downstream tooling read one
-    # format whether the numbers came from a bench or a live run
-    out["telemetry"] = telemetry_summary([ms], counters={"examples": batch})
     # 6ND fwd+bwd, +2ND for the remat'd second forward (attention's
     # seq^2 term omitted — labelled analytic, a lower bound)
     tokens = batch * seq
     flops = (8 if cfg.remat else 6) * n_params * tokens
     out.update(_roofline(train_step, (state,), ms / 1e3, on_tpu,
                          analytic_flops=flops))
+    out.update(_mem_fields(train_step, (state,)))
+    # the leg embeds its step timing + MFU/peak-HBM evidence as
+    # schema-valid telemetry records (docs/telemetry.md): tpu_watch.sh /
+    # downstream tooling read one format whether the numbers came from
+    # a bench or a live run
+    out["telemetry"] = leg_telemetry([ms], out,
+                                     counters={"examples": batch})
     return out
 
 
@@ -580,8 +673,10 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
     # the first measurement, for the same reason).
     head = {"n_params": n_params, "complete": False}
     with _leg_span("headline"):
-        xla_ms = time_apex_xla(make_params, grads)
+        head_perf = {}
+        xla_ms = time_apex_xla(make_params, grads, fields=head_perf)
         head["xla_impl_ms"] = round(xla_ms, 3)
+        head.update(head_perf)
         flush("headline", head, merge=True)
         fused_ms = time_apex_fused_flat(make_params, grads)
         head["fused_flat_impl_ms"] = round(fused_ms, 3)
@@ -627,6 +722,9 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
     head["vs_baseline_fp32_pair"] = round(base_ms / min(xla_ms, fused_ms), 3)
     head["vs_baseline_bf16_pair"] = round(
         base_bf16_ms / min(fused_bf16_ms, fused_bf16s_ms), 3)
+    # every leg embeds MFU + peak-HBM evidence as schema-valid telemetry
+    # (the apply_perf_results audit reads it back)
+    head["telemetry"] = leg_telemetry([best_ms], head)
     head["complete"] = True
     flush("headline", head, merge=True)
 

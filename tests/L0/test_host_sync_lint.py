@@ -12,10 +12,16 @@ inherently host-side boundary):
 
   * ``telemetry/registry.py``  — the single batched flush read
   * ``telemetry/events.py``    — the batched scaler-state read
+  * ``telemetry/memory.py``    — the allocator poll at flush cadence
   * ``resilience/guard.py``    — the batched health-check/snapshot read
   * ``checkpoint.py``          — serialization is a host operation
   * ``interop/__init__.py``    — the torch bridge is host-side by design
   * ``pyprof/prof.py``         — measured timing must synchronize
+
+A second, narrower budget covers ``device.memory_stats()`` (ISSUE 6):
+allocator polling is a host read too, and it must stay batched at the
+registry-flush cadence — so the ONLY module allowed to call it is
+``telemetry/memory.py`` (``MemoryMonitor`` / ``device_memory_stats``).
 
 Anything else needs either routing through the registry/guard batching
 or an explicit ``# host-sync: ok`` waiver with a reason.
@@ -32,14 +38,23 @@ PKG = os.path.join(ROOT, "apex_tpu")
 SANCTIONED = {
     os.path.join("telemetry", "registry.py"),
     os.path.join("telemetry", "events.py"),
+    os.path.join("telemetry", "memory.py"),
     os.path.join("resilience", "guard.py"),
     "checkpoint.py",
     os.path.join("interop", "__init__.py"),
     os.path.join("pyprof", "prof.py"),
 }
 
+#: allocator polling is its own, narrower budget: memory_stats() calls
+#: belong ONLY in the memory module (registry.flush reaches them
+#: through MemoryMonitor.observe_flush)
+MEMSTATS_SANCTIONED = {
+    os.path.join("telemetry", "memory.py"),
+}
+
 # a CALL, not a docstring mention: the name must be followed by "("
 _SYNC_CALL = re.compile(r"\b(device_get|block_until_ready)\s*\(")
+_MEMSTATS_CALL = re.compile(r"\b(memory_stats)\s*\(")
 _WAIVER = "# host-sync: ok"
 
 
@@ -69,6 +84,28 @@ def test_no_host_syncs_outside_sanctioned_modules():
         f"'{_WAIVER}' waiver with a reason):\n" + "\n".join(offenders))
 
 
+def test_no_memory_stats_outside_memory_module():
+    """The narrower allocator-poll budget (ISSUE 6): a stray
+    ``memory_stats()`` anywhere but ``telemetry/memory.py`` is an
+    unbatched host read the memory monitor exists to centralize."""
+    offenders = []
+    for path in _py_files():
+        rel = os.path.relpath(path, PKG)
+        if rel in MEMSTATS_SANCTIONED:
+            continue
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                m = _MEMSTATS_CALL.search(line)
+                if m and _WAIVER not in line:
+                    offenders.append(f"apex_tpu/{rel}:{ln}: {m.group(1)} "
+                                     f"call: {line.strip()[:80]}")
+    assert offenders == [], (
+        "memory_stats() calls outside telemetry/memory.py (route the "
+        "poll through telemetry.memory.MemoryMonitor / "
+        "device_memory_stats, or add an explicit "
+        f"'{_WAIVER}' waiver with a reason):\n" + "\n".join(offenders))
+
+
 def test_lint_actually_detects_a_call(tmp_path):
     """The lint's regex matches real call syntax and skips docstring
     mentions — guard against the lint rotting into a tautology."""
@@ -76,9 +113,11 @@ def test_lint_actually_detects_a_call(tmp_path):
     assert _SYNC_CALL.search("jax.block_until_ready (x)")
     assert not _SYNC_CALL.search("one ``jax.device_get`` per flush")
     assert not _SYNC_CALL.search("the device_get budget")
+    assert _MEMSTATS_CALL.search("stats = device.memory_stats()")
+    assert not _MEMSTATS_CALL.search("polls ``device.memory_stats`` data")
 
 
 def test_sanctioned_files_exist():
     """A sanctioned path that no longer exists is stale lint config."""
-    for rel in SANCTIONED:
+    for rel in SANCTIONED | MEMSTATS_SANCTIONED:
         assert os.path.exists(os.path.join(PKG, rel)), rel

@@ -34,6 +34,26 @@ def test_all_checks_pass_tiny_interpret_mode():
     assert out["backend"] == "cpu" and out["tiny"] is True
 
 
+def test_vmem_budget_check_over_estimator_math(monkeypatch):
+    """ISSUE 6 satellite: the compiled-footprint check asserts every
+    flash kernel variant's resolved blocks model under the
+    ``_clamp_blocks`` budget, and the estimator math itself still
+    points the right way (a config the clamp would never emit models
+    OVER budget — the check is not a tautology)."""
+    sm = _load_smoke()
+    ratio = sm.check_vmem_budget(tiny=True)
+    assert 0.0 < ratio <= 1.0, ratio
+
+    from apex_tpu.contrib.multihead_attn import flash as F
+    budget = F._VMEM_BUDGET_MB * 2 ** 20
+    # an absurd un-clamped config must exceed the budget in the model
+    assert F.vmem_estimate(4096, 8192, 64, 4, True, "fused") > budget
+    # and a shrunk budget makes the resolved configs breach it, so the
+    # check actually FAILS when model and budget drift apart
+    monkeypatch.setenv("APEX_TPU_FLASH_VMEM_MB", "0.05")
+    assert sm.check_vmem_budget(tiny=True) > 1.0
+
+
 def test_only_filter_and_failure_exit_codes(monkeypatch):
     sm = _load_smoke()
     out = sm.run_checks(tiny=True, only={"multi_tensor"})

@@ -37,6 +37,9 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_GTRAIN_CMD": "",
            "APEX_WATCH_SMOKE_CMD": "echo smoke-ok",
            "APEX_WATCH_APPLY_CMD": "echo applied",
+           # default mem sampler dials the backend (a jax import per
+           # stage) — stub it off; the stage_mem test overrides it
+           "APEX_WATCH_MEM_CMD": "",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
            **env_extra}
@@ -309,6 +312,49 @@ def test_stage_spans_written_and_renderable(tmp_path):
     assert rcli.returncode == 0, rcli.stderr[-2000:]
     assert "span timeline summary" in rcli.stdout
     assert "watch.bench" in rcli.stdout
+
+
+def test_stage_mem_counter_events_in_streaming_trace(tmp_path):
+    """ISSUE 6 satellite: each capture stage appends a device
+    memory_stats sample as a chrome COUNTER event ('ph':'C') to the
+    crash-safe streaming timeline, and the tolerant loader still parses
+    the spans around it.  An unsupported sampler (empty output — the
+    CPU path of device_memory_json) appends nothing."""
+    fake = '{"bytes_in_use": 1234, "peak_bytes_in_use": 5678}'
+    r, log = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_MEM_CMD": f"echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    raw = (tmp_path / "WATCH_TRACE_r5.json").read_text()
+    counters = [json.loads(line.rstrip(",")) for line in raw.splitlines()
+                if '"watch.device_mem"' in line]
+    # one sample per executed on-chip stage (smoke + kernels + bench +
+    # guard_train + train — the empty env overrides fall back to the
+    # default train commands, which run and fail fast in the tmp dir)
+    assert len(counters) == 5, raw
+    assert all(c["ph"] == "C" and c["args"]["bytes_in_use"] == 1234
+               for c in counters)
+    # the loader drops counters, keeps the spans (ph "X" only)
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.bench" in names and "watch.device_mem" not in names
+
+    # empty sampler output (the unsupported-backend contract) -> no
+    # counter events, and the watcher still completes
+    r2, _ = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_MEM_CMD": "echo ''",
+        "APEX_WATCH_TRACE": "WATCH_TRACE_empty.json",
+    })
+    assert r2.returncode == 0
+    raw2 = (tmp_path / "WATCH_TRACE_empty.json").read_text()
+    assert "watch.device_mem" not in raw2
 
 
 def test_stage_spans_record_failures_too(tmp_path):

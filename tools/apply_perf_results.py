@@ -120,6 +120,52 @@ def telemetry_violations(artifact) -> list:
     return out
 
 
+def perf_field_violations(artifact) -> list:
+    """Legs that embed a telemetry block but no MFU / peak-HBM evidence
+    (VERDICT round-5: 'no MFU/HBM fields landed in the captured legs').
+    A leg satisfies the audit with either the leg-dict fields
+    (``mfu_pct``/``mfu_analytic_pct``, ``hbm_*_bytes`` — a BYTE count;
+    ``hbm_util_pct`` is a utilization ratio and must not stand in for
+    the missing footprint) or the equivalent gauges inside its
+    telemetry records (``mfu_pct``, ``mem.*`` — the
+    ``bench.leg_telemetry`` shape).  Warnings only — the caller gates
+    on the artifact being TPU-backed, and legs an assembled mixed
+    artifact tags ``_backend != tpu`` (CPU stand-ins honestly carry no
+    MFU) are skipped."""
+    out = []
+
+    def walk(node, path):
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+            return
+        if not isinstance(node, dict):
+            return
+        tel = node.get("telemetry")
+        if isinstance(tel, dict) and node.get("_backend") in (None, "tpu"):
+            recs = tel.get("records") or []
+            gauges = {r.get("name") for r in recs
+                      if isinstance(r, dict) and r.get("type") == "gauge"}
+            has_hbm = (any(k.startswith("hbm_") and k.endswith("_bytes")
+                           and node[k] is not None for k in node)
+                       or any(isinstance(n, str) and n.startswith("mem.")
+                              for n in gauges))
+            has_mfu = (any(k.startswith("mfu") for k in node)
+                       or "mfu_pct" in gauges)
+            if not has_hbm:
+                out.append(f"{path}: leg embeds telemetry but no "
+                           "peak-HBM field (hbm_* / mem.* gauge)")
+            if not has_mfu:
+                out.append(f"{path}: leg embeds telemetry but no MFU "
+                           "field (mfu_pct / mfu_analytic_pct)")
+        for k, v in node.items():
+            if k != "telemetry":
+                walk(v, f"{path}.{k}")
+
+    walk(artifact if isinstance(artifact, dict) else {}, "artifact")
+    return out
+
+
 def _cfg(best):
     """Strictly-validated ``"QxK"`` config string -> (q, k) ints, else
     None.  A non-config winner (``jax_ref_fwdbwd`` has a single 'x' in
@@ -338,6 +384,11 @@ def main(argv=None):
     for label, art in (("bench", bench), ("kernels", kern)):
         for v in telemetry_violations(art):
             print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
+        # TPU-backed legs must carry their MFU/peak-HBM evidence (CPU
+        # stand-ins honestly carry no MFU, so they are not audited)
+        if isinstance(art, dict) and art.get("backend") in ("tpu", "mixed"):
+            for v in perf_field_violations(art):
+                print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
 
     prof, rows = decide(bench, kern)
     table = render(rows)

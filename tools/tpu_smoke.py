@@ -133,6 +133,36 @@ def check_mlp(tiny):
     return _rel_err(got, want)
 
 
+def check_vmem_budget(tiny):
+    """Compiled-footprint gate for the flash kernel family (ISSUE 6
+    satellite: the ``_clamp_blocks`` budget model has been unvalidated
+    since round 4): resolve the block sizes every kernel variant would
+    actually run with (env pin > tuning profile > built-in, exactly the
+    ``_clamp_blocks`` chain) at the production regime (D=64, bf16, seq
+    512) and assert the per-grid-step VMEM estimate fits the budget the
+    clamp enforces.  Returns the worst used/budget ratio — the check
+    fails when any variant's resolved config models over budget, i.e.
+    when the clamp loop and the footprint model have drifted apart.
+    Pure estimator math (no compile), so the tiny tier-1 variant runs
+    the identical check."""
+    import os
+    from apex_tpu.contrib.multihead_attn import flash as F
+    budget = float(os.environ.get("APEX_TPU_FLASH_VMEM_MB",
+                                  F._VMEM_BUDGET_MB)) * 2 ** 20
+    D = 64
+    sq = sk = 128 if tiny else 512
+    worst = 0.0
+    # every kernel variant x dtype x bias layout the clamp chain serves
+    for bwd in (False, "dq", "dkv", "fused", True):
+        for esz in (2, 4):                    # bf16 / f32 streams
+            for bias_per_q in (False, True):
+                bq, bk = F._clamp_blocks(None, None, D, esz, bias_per_q,
+                                         bwd=bwd, sq=sq, sk=sk)
+                est = F.vmem_estimate(bq, bk, D, esz, bias_per_q, bwd)
+                worst = max(worst, est / budget)
+    return worst
+
+
 def check_multi_tensor(tiny):
     import jax.numpy as jnp
     import numpy as np
@@ -164,6 +194,9 @@ CHECKS = {
     "layer_norm": (check_layer_norm, 1e-4),
     "mlp": (check_mlp, 1e-4),
     "multi_tensor": (check_multi_tensor, 1e-5),
+    # not a numerics check: the value is the worst used/budget VMEM
+    # ratio over the flash kernel variants — 1.0 is the budget line
+    "vmem_budget": (check_vmem_budget, 1.0),
 }
 
 

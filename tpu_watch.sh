@@ -106,6 +106,21 @@ stage_span() {  # $1: stage name, $2: t0 (us), $3: rc
   printf '{"name":"watch.%s","cat":"stage","ph":"X","ts":%s,"dur":%s,"pid":1,"tid":1,"args":{"rc":%s}},\n' \
     "$1" "$2" $(( t1 - $2 )) "${3:-0}" >> "$WATCH_TRACE"
 }
+# per-stage device memory: one allocator read appended as a chrome
+# COUNTER event ("ph":"C") to the same streaming timeline, so the
+# rendered trace shows an HBM curve point after every capture stage
+# (docs/telemetry.md Memory).  Best-effort: an unsupported backend or
+# a wedged tunnel (the timeout bounds the dial) appends nothing.
+MEM_CMD=${APEX_WATCH_MEM_CMD:-'python -c "from apex_tpu.telemetry.memory import device_memory_json as j; print(j())"'}
+MEM_TO=${APEX_WATCH_MEM_TO:-30}
+stage_mem() {  # no args: sample the device allocator now
+  [ -n "$MEM_CMD" ] || return 0
+  local js; js=$(timeout -k 5 "$MEM_TO" bash -c "$MEM_CMD" 2>/dev/null | tail -1)
+  case "$js" in "{"*"}") ;; *) return 0;; esac
+  [ -s "$WATCH_TRACE" ] || printf '[\n' > "$WATCH_TRACE"
+  printf '{"name":"watch.device_mem","cat":"mem","ph":"C","ts":%s,"pid":1,"tid":1,"args":%s},\n' \
+    "$(now_us)" "$js" >> "$WATCH_TRACE"
+}
 
 # complete/bench_complete parse the JSON and check TOP-LEVEL fields: a
 # whole-file grep would match the '"backend": "tpu"' embedded in a CPU
@@ -144,6 +159,7 @@ for i in $(seq 1 "$N_PROBES"); do
       timeout -k 10 "$SMOKE_TO" bash -c "$SMOKE_CMD" >> "$LOG" 2>&1
       rc0=$?
       stage_span smoke "$t0" "$rc0"
+      stage_mem
       echo "$(date +%H:%M:%S) tpu_smoke done rc=$rc0" >> "$LOG"
       if [ $rc0 -ne 0 ]; then
         echo "$(date +%H:%M:%S) tpu_smoke FAILED; kernels unusable on this chip/toolchain — resuming probe loop" >> "$LOG"
@@ -160,6 +176,7 @@ for i in $(seq 1 "$N_PROBES"); do
       timeout -k 10 "$KERN_TO" bash -c "$KERN_CMD" > "$KERN_JSON" 2>> "$LOG"
       rc1=$?
       stage_span bench_kernels "$t0" "$rc1"
+      stage_mem
       echo "$(date +%H:%M:%S) bench_kernels.py done rc=$rc1" >> "$LOG"
       if [ $rc1 -ne 0 ] || [ ! -s "$KERN_JSON" ]; then
         bash -c "$ASSEMBLE_CMD $KERN_LEGS --kind kernels" > "$KERN_JSON" 2>> "$LOG"
@@ -184,6 +201,7 @@ for i in $(seq 1 "$N_PROBES"); do
       timeout -k 10 "$BENCH_TO" bash -c "$BENCH_CMD" > "$BENCH_JSON".run 2>> "$LOG"
       rc3=$?
       stage_span bench "$t0" "$rc3"
+      stage_mem
       echo "$(date +%H:%M:%S) bench.py done rc=$rc3" >> "$LOG"
       if [ $rc3 -eq 0 ] && complete "$BENCH_JSON".run; then
         mv "$BENCH_JSON".run "$BENCH_JSON"
@@ -210,6 +228,7 @@ for i in $(seq 1 "$N_PROBES"); do
       timeout -k 10 "$GTRAIN_TO" bash -c "$GTRAIN_CMD" >> "$GTRAIN_LOG" 2>&1
       rcg=$?   # capture BEFORE the $(date) substitution resets $?
       stage_span guard_train "$t0" "$rcg"
+      stage_mem
       echo "$(date +%H:%M:%S) guard train leg done rc=$rcg" >> "$LOG"
       if [ $rcg -eq 0 ]; then
         date -u +%Y-%m-%dT%H:%M:%SZ > "$GTRAIN_DONE"
@@ -229,6 +248,7 @@ for i in $(seq 1 "$N_PROBES"); do
       timeout -k 10 "$TRAIN_TO" bash -c "$TRAIN_CMD" > "$TRAIN_LOG" 2>&1
       rc2=$?   # capture BEFORE the $(date) substitution resets $?
       stage_span train "$t0" "$rc2"
+      stage_mem
       echo "$(date +%H:%M:%S) train run (save+resume) done rc=$rc2" >> "$LOG"
       if [ $rc2 -ne 0 ]; then
         # a failed/partial train log must not be mistaken for a pass,
