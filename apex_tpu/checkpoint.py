@@ -69,7 +69,11 @@ class _CrcWriter:
 
     def write(self, b):
         self.crc = zlib.crc32(b, self.crc)
-        self.length += len(b)
+        # nbytes, not len(): at protocol 5 the pickler hands large array
+        # payloads over as raw buffer-protocol objects (PickleBuffer),
+        # which have no len() — any leaf past the ~64 KB framing
+        # threshold used to crash the save
+        self.length += memoryview(b).nbytes
         return self._fh.write(b)
 
 

@@ -32,7 +32,13 @@ Mechanism mapping (reference → here):
 - ``predivide`` (``:309``) → supported: grads are scaled by ``1/world``
   before the reduction so the sum never overflows fp16/bf16 dynamic range.
 - ``e5m2_allgather`` → ``bf16_allgather`` (bf16 is the TPU-native 8-exp
-  format; e5m2 buys nothing here).
+  format; e5m2 buys nothing here), generalized by ``allgather_scheme``
+  ("bf16" | "int8_blockscale") and — for the gradient reduce-scatter —
+  ``collective_scheme`` ("fp32" | "bf16" | "int8_blockscale" |
+  "adasum"): the ``parallel.collectives`` registry's compressed /
+  adaptive wire formats, with an optional error-feedback ``residual``
+  threaded through :meth:`step` (see docs/parallel.md "Collective
+  schemes").
 
 Usage: the step is a *collective* — call it inside ``shard_map`` (or
 ``pmap``) with ``shard_axis``/``replica_axis`` bound, passing each device's
@@ -81,7 +87,8 @@ class _DistributedFusedBase:
     def __init__(self, lr, weight_decay=0.0, shard_axis="data",
                  replica_axis: Optional[str] = None, predivide=True,
                  bf16_allgather=False, check_overflow=True, impl=None,
-                 state_dtype=None):
+                 state_dtype=None, collective_scheme=None,
+                 allgather_scheme=None):
         if impl is None:
             # measured tuning profile ("zero_impl", written by
             # tools/apply_perf_results.py from the on-chip adam_update /
@@ -104,6 +111,16 @@ class _DistributedFusedBase:
         # (optimizers/_base.py): fp32 math, narrow store.  The master
         # shard p always stays fp32.
         self.state_dtype = resolve_state_dtype(state_dtype)
+        # compressed/adaptive collective schemes (parallel.collectives,
+        # docs/parallel.md): ``collective_scheme`` rides the gradient
+        # reduce-scatter ("fp32" | "bf16" | "int8_blockscale" |
+        # "adasum"; None = explicit arg > APEX_TPU_COLLECTIVES env >
+        # legacy psum_scatter), ``allgather_scheme`` the param gather
+        # ("bf16" ≡ bf16_allgather; "int8_blockscale" block-quantizes
+        # the shard).  Resolved at trace time so an env A/B needs no
+        # reconstruction.
+        self.collective_scheme = collective_scheme
+        self.allgather_scheme = allgather_scheme
         self._fl: Optional[TreeFlattener] = None
         self._fl_key = None
 
@@ -126,38 +143,184 @@ class _DistributedFusedBase:
 
     # -- collectives ---------------------------------------------------------
 
-    def _reduce_scatter(self, flat_g):
+    def _resolve_scheme(self, which):
+        """Trace-time scheme resolution for this instance's collectives
+        (explicit constructor arg > env for the gradient reduce-scatter;
+        the param ALLGATHER honors only the explicit arg — quantizing
+        params is a deliberate accuracy trade the ambient
+        APEX_TPU_COLLECTIVES A/B knob must not flip implicitly.  The
+        DDP-path tuning key is never consulted — a measured DDP winner
+        says nothing about the ZeRO wire, whose knob is the
+        constructor)."""
+        from ...parallel import collectives as _coll
+        if which == "ag":
+            if self.allgather_scheme is None:
+                return None
+            return _coll.resolve(self.allgather_scheme, tuning_key=None)
+        return _coll.resolve(self.collective_scheme, tuning_key=None)
+
+    def _meter(self, op, logical, wire, seconds, scheme, dtype):
+        """ZeRO collective meter: one record_collective per traced
+        collective (op="reduce_scatter"|"allgather"), free without a
+        registry/tracer — same posture as the DDP meter."""
+        from ...telemetry import events as _tel_events
+        if _tel_events.metering():
+            _tel_events.record_collective(
+                self.shard_axis, int(logical), 1, seconds,
+                wire_bytes=int(wire), dtype=dtype, scheme=scheme, op=op)
+
+    def _reduce_scatter(self, flat_g, residual=None):
         """Local full flat grads -> this device's reduced shard.
         RS over shard_axis (ICI), then AR over replica_axis (DCN) —
-        the reference's two-level schedule (:329-340) as two collectives."""
-        world = _axis_sz(self.shard_axis)
+        the reference's two-level schedule (:329-340) as two collectives.
+
+        With a compressed/adaptive ``collective_scheme``, the RS is an
+        ``all_to_all`` of the scheme's wire representation + a local
+        dequant-sum: each peer's contribution to this device's shard
+        arrives compressed (int8 codes + block scales, bf16, or fp32
+        rows for the adasum merge).  The inter-replica AR stays fp32 —
+        the DCN hop carries 1/N of the bytes already.  ``residual``
+        threads the int8 error-feedback state (full flat, fp32,
+        per-device); returns ``(g_shard, new_residual)``.
+        """
+        import time as _time
+        from ...parallel import collectives as _coll
+        spec = self._resolve_scheme("rs")
+        world_s = _axis_sz(self.shard_axis)
+        world = world_s
         if self.replica_axis is not None:
             world = world * _axis_sz(self.replica_axis)
-        if self.predivide:
-            flat_g = flat_g * (1.0 / world)
-        g_shard = jax.lax.psum_scatter(flat_g, self.shard_axis,
-                                       scatter_dimension=0, tiled=True)
+        t0 = _time.perf_counter()
+        if spec is None or spec.scheme == "fp32":
+            if self.predivide:
+                flat_g = flat_g * (1.0 / world)
+            g_shard = jax.lax.psum_scatter(flat_g, self.shard_axis,
+                                           scatter_dimension=0, tiled=True)
+            if self.replica_axis is not None:
+                g_shard = jax.lax.psum(g_shard, self.replica_axis)
+            if not self.predivide:
+                g_shard = g_shard / world
+            nbytes = flat_g.size * jnp.dtype(flat_g.dtype).itemsize
+            self._meter("reduce_scatter", nbytes, nbytes,
+                        _time.perf_counter() - t0,
+                        spec.scheme if spec else None, str(flat_g.dtype))
+            return g_shard, residual
+
+        info = _coll.get_scheme(spec.scheme)
+        _coll.chaos_gate(f"zero.reduce_scatter.{info.name}")
+        x = flat_g.astype(jnp.float32)
+        if self.predivide and not info.self_scaling:
+            x = x * (1.0 / world)
+        per = x.shape[0] // world_s
+        new_residual = residual
+        if spec.scheme == "int8_blockscale":
+            block = spec.block
+            if per % block:
+                raise ValueError(
+                    f"int8_blockscale reduce-scatter needs block "
+                    f"({block}) to divide the shard length ({per}); use "
+                    f"a block that divides total/{world_s}")
+            if residual is not None:
+                x = x + residual
+            q, scales = _coll.quantize_blockscale(x, block)
+            if residual is not None:
+                new_residual = x - _coll.dequantize_blockscale(
+                    q, scales, x.shape[0])
+            nb_per = per // block
+            qt = jax.lax.all_to_all(q.reshape(world_s, nb_per, block),
+                                    self.shard_axis, 0, 0)
+            st = jax.lax.all_to_all(scales.reshape(world_s, nb_per),
+                                    self.shard_axis, 0, 0)
+            g_shard = jnp.sum(qt.astype(jnp.float32) * st[..., None],
+                              axis=0).reshape(per)
+        elif spec.scheme == "bf16":
+            xt = jax.lax.all_to_all(
+                x.astype(jnp.bfloat16).reshape(world_s, per),
+                self.shard_axis, 0, 0)
+            g_shard = jnp.sum(xt.astype(jnp.float32), axis=0)
+        elif spec.scheme == "adasum":
+            xt = jax.lax.all_to_all(x.reshape(world_s, per),
+                                    self.shard_axis, 0, 0)
+            g_shard = _coll.adasum_merge(xt)
+        else:
+            raise ValueError(
+                f"collective scheme {spec.scheme!r} has no ZeRO "
+                "reduce-scatter lowering (custom schemes ride the DDP "
+                "allreduce path)")
         if self.replica_axis is not None:
             g_shard = jax.lax.psum(g_shard, self.replica_axis)
-        if not self.predivide:
+            if info.self_scaling:
+                # adasum across replica groups: average the per-group
+                # merges (the merge already carries its own magnitude)
+                g_shard = g_shard / _axis_sz(self.replica_axis)
+        if not self.predivide and not info.self_scaling:
             g_shard = g_shard / world
-        return g_shard
+        self._meter("reduce_scatter", x.size * 4,
+                    info.wire_bytes(x.size, spec.block),
+                    _time.perf_counter() - t0, spec.scheme,
+                    info.wire_dtype)
+        return g_shard, new_residual
 
-    def _allgather(self, p_shard):
-        if self.bf16_allgather:
-            p_shard = p_shard.astype(jnp.bfloat16)
+    def init_residual(self, params):
+        """Zero int8 error-feedback residual for the reduce-scatter —
+        full flat, fp32, per-device.  MUST run inside shard_map/pmap
+        with ``shard_axis`` bound (the flat layout depends on the shard
+        count); carry it through ``step(..., residual=...)``."""
+        n = _axis_sz(self.shard_axis)
+        return jnp.zeros((self._flattener(params, n).total,), jnp.float32)
+
+    def _ag_invariant(self, x):
         # all_gather_invariant: identical collective, but its output is
         # *replicated* under the vma system (every device provably holds the
         # same full buffer), which is what gathered params are — plain
         # all_gather would force check_vma=False on every enclosing shard_map
         try:
             from jax._src.lax.parallel import all_gather_invariant
-            full = all_gather_invariant(p_shard, self.shard_axis, axis=0,
+            return all_gather_invariant(x, self.shard_axis, axis=0,
                                         tiled=True)
         except ImportError:  # pragma: no cover - older jax
-            full = jax.lax.all_gather(p_shard, self.shard_axis, axis=0,
+            return jax.lax.all_gather(x, self.shard_axis, axis=0,
                                       tiled=True)
-        return full.astype(jnp.float32)
+
+    def _allgather(self, p_shard):
+        import time as _time
+        from ...parallel import collectives as _coll
+        spec = self._resolve_scheme("ag")
+        t0 = _time.perf_counter()
+        if spec is not None and spec.scheme == "int8_blockscale":
+            _coll.chaos_gate("zero.allgather.int8_blockscale")
+            x = p_shard.astype(jnp.float32)
+            if x.shape[0] % spec.block:
+                # a block that doesn't divide the shard would pad each
+                # shard before the gather, silently interleaving zeros
+                # into the flat buffer unflatten slices by fixed offsets
+                raise ValueError(
+                    f"int8_blockscale allgather needs block ({spec.block}) "
+                    f"to divide the shard length ({x.shape[0]})")
+            q, scales = _coll.quantize_blockscale(x, spec.block)
+            qg = self._ag_invariant(q)           # (world*nb, block)
+            sg = self._ag_invariant(scales)      # (world*nb,)
+            full = (qg.astype(jnp.float32) * sg[:, None]).reshape(-1)
+            self._meter("allgather", x.size * 4,
+                        _coll.wire_bytes("int8_blockscale", x.size,
+                                         spec.block),
+                        _time.perf_counter() - t0, "int8_blockscale",
+                        "int8")
+            return full
+        if spec is not None and spec.scheme == "adasum":
+            raise ValueError("adasum is a reduction rule; it has no "
+                             "allgather meaning")
+        bf16 = (self.bf16_allgather
+                or (spec is not None and spec.scheme == "bf16"))
+        if bf16:
+            p_shard = p_shard.astype(jnp.bfloat16)
+        full = self._ag_invariant(p_shard).astype(jnp.float32)
+        nbytes = p_shard.size * jnp.dtype(p_shard.dtype).itemsize
+        self._meter("allgather", p_shard.size * 4, nbytes,
+                    _time.perf_counter() - t0,
+                    "bf16" if bf16 else (spec.scheme if spec else None),
+                    str(p_shard.dtype))
+        return full
 
     def _global_sumsq(self, x_shard):
         """Global sum-of-squares from per-device shards (the side grad-norm
@@ -245,14 +408,17 @@ class DistributedFusedAdam(_DistributedFusedBase):
         self.max_grad_norm = max_grad_norm
 
     def step(self, state: ShardedAdamState, grads, params, *, scale=1.0,
-             lr=None):
+             lr=None, residual=None):
         """One collective step.  ``grads``: this device's local UNREDUCED
-        grads (full model); returns (new_params_full_tree, new_state)."""
+        grads (full model); returns (new_params_full_tree, new_state) —
+        or (params, state, new_residual) when ``residual`` threads the
+        int8 error-feedback state (see :meth:`init_residual`)."""
         n = _axis_sz(self.shard_axis)
         fl = self._flattener(params, n)
         inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
 
-        g_shard = self._reduce_scatter(fl.flatten(grads))
+        g_shard, new_residual = self._reduce_scatter(fl.flatten(grads),
+                                                     residual)
         ok = (self._finite_flag(g_shard) if self.check_overflow
               else jnp.ones((), jnp.float32))
 
@@ -305,7 +471,12 @@ class DistributedFusedAdam(_DistributedFusedBase):
         new_state = self._select(ok, new_state,
                                  state._replace(gnorm=gnorm))
         full = self._allgather(new_state.p)
-        return fl.unflatten(full), new_state
+        if residual is None:
+            return fl.unflatten(full), new_state
+        # overflow skip must also revert the error-feedback residual —
+        # a skipped step's quantization error was never applied
+        new_residual = jnp.where(ok > 0, new_residual, residual)
+        return fl.unflatten(full), new_state, new_residual
 
 
 class DistributedFusedLAMB(_DistributedFusedBase):
@@ -336,12 +507,13 @@ class DistributedFusedLAMB(_DistributedFusedBase):
         self.use_nvlamb = use_nvlamb
 
     def step(self, state: ShardedLAMBState, grads, params, *, scale=1.0,
-             lr=None):
+             lr=None, residual=None):
         n = _axis_sz(self.shard_axis)
         fl = self._flattener(params, n)
         inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
 
-        g_shard = self._reduce_scatter(fl.flatten(grads))
+        g_shard, new_residual = self._reduce_scatter(fl.flatten(grads),
+                                                     residual)
         ok = (self._finite_flag(g_shard) if self.check_overflow
               else jnp.ones((), jnp.float32))
 
@@ -414,4 +586,7 @@ class DistributedFusedLAMB(_DistributedFusedBase):
                                      self._store_moment(v_new), gnorm)
         new_state = self._select(ok, new_state, state._replace(gnorm=gnorm))
         full = self._allgather(new_state.p)
-        return fl.unflatten(full), new_state
+        if residual is None:
+            return fl.unflatten(full), new_state
+        new_residual = jnp.where(ok > 0, new_residual, residual)
+        return fl.unflatten(full), new_state, new_residual

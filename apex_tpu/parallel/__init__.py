@@ -18,6 +18,8 @@ from .mesh import (
     MODEL_AXIS,
     SEQ_AXIS,
 )
+from . import collectives
+from .collectives import CollectiveSpec
 from .distributed import DistributedDataParallel, Reducer, allreduce_tree
 from .sync_batchnorm import SyncBatchNorm, sync_batch_norm, batch_norm_stats
 from .sequence import (ring_attention, ulysses_attention,

@@ -28,9 +28,17 @@ Knobs that are declared no-ops (kept for API compat, documented here against
 ``allreduce_trigger_params``, ``num_allreduce_streams``,
 ``retain_allreduce_buffers`` — bucket sizing, hook timing and stream fan-out
 have no SPMD meaning; XLA owns scheduling.
+
+Beyond the reference: per-bucket compressed/adaptive collective schemes
+(``parallel.collectives`` — bf16, block-scaled int8 with error-feedback
+residuals, Adasum adaptive merge), selected via ``collective_scheme=`` /
+``APEX_TPU_COLLECTIVES`` / the tuning profile and metered as
+logical-vs-wire bytes by the telemetry collective counters.  See
+docs/parallel.md "Collective schemes".
 """
 from __future__ import annotations
 
+import dataclasses
 import time
 import warnings
 from typing import Any, Callable, Optional
@@ -42,10 +50,26 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .mesh import DATA_AXIS, current_mesh, axis_is_bound, lax_axis_size
 
 
+def _leaf_paths(grads, need_paths: bool):
+    """Flatten with key paths when available (per-bucket callable
+    routing); path strings are empty on jaxes without the API."""
+    if need_paths:
+        fw = getattr(jax.tree_util, "tree_flatten_with_path", None)
+        if fw is not None:
+            pl, treedef = fw(grads)
+            keystr = getattr(jax.tree_util, "keystr", lambda kp: str(kp))
+            return ([l for _, l in pl], [keystr(kp) for kp, _ in pl],
+                    treedef)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    return leaves, [""] * len(leaves), treedef
+
+
 def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
                    average: bool = True,
                    predivide_factor: Optional[float] = None,
-                   always_fp32: bool = False):
+                   always_fp32: bool = False,
+                   scheme=None, residuals=None,
+                   min_compress_bytes: Optional[int] = None):
     """psum a grad pytree over ``axis_name`` with the reference's dtype /
     scaling semantics (``allreduce_bucket``, distributed.py:426-476).
 
@@ -53,27 +77,47 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
     pmap).  Outside any mapped context it is an identity (world size 1), like
     the reference with ``torch.distributed`` uninitialized.
 
+    Collective schemes (``parallel.collectives``, docs/parallel.md):
+    ``scheme`` selects a compressed/adaptive reduction per-bucket
+    (per-leaf) — a scheme name ("fp32" | "bf16" | "int8_blockscale" |
+    "adasum"), a spec string ("int8_blockscale:block=128"), a
+    :class:`~apex_tpu.parallel.collectives.CollectiveSpec`, or a
+    callable ``(path, leaf) -> scheme|None`` for custom routing.
+    ``scheme=None`` consults ``APEX_TPU_COLLECTIVES`` then the tuning
+    profile (``ddp_collective_scheme``, TPU only); with neither set the
+    legacy native-dtype psum below runs unchanged.  Leaves smaller than
+    ``min_compress_bytes`` (default spec ``min_bytes``) stay fp32.
+    ``residuals`` threads the int8 error-feedback residual pytree
+    (:func:`collectives.init_residuals`) — when passed, the return
+    value becomes ``(reduced, new_residuals)``; carry ``new_residuals``
+    in step state so TrainGuard snapshots/rollback replay it bitwise.
+
     vma-typed shard_map note: gradients taken wrt REPLICATED (unvarying)
     params are already psum-SUMMED by the cotangent rule.  This function
     inspects each leaf's varying-axes type and SKIPS the redundant psum for
     already-reduced leaves (still applying the average/predivide scaling),
     so DDP semantics hold whether grads arrive per-device (pmap, lifted
     params, check_vma=False) or pre-summed (replicated params under vma).
+    Pre-summed leaves are never compressed (no collective runs for them).
     """
+    from . import collectives as _coll
     if not axis_is_bound(axis_name):
-        return grads
+        return grads if residuals is None else (grads, residuals)
     world = lax_axis_size(axis_name)
     # telemetry collective meter (docs/telemetry.md): payload bytes and
     # leaf count are static facts of the traced reduction — counted ONLY
     # for leaves that actually psum (vma-pre-summed leaves emit no
     # collective, so they must not inflate the byte meter future
-    # comms-perf decisions read).  The wall time is HOST time around
-    # building the reduction (trace/dispatch cost under jit — on-device
+    # comms-perf decisions read).  ``wire`` is the bytes actually
+    # crossing the wire under the selected scheme (== ``bytes`` when
+    # nothing compresses).  The wall time is HOST time around building
+    # the reduction (trace/dispatch cost under jit — on-device
     # collective time belongs to the profiler).  One attribute check
     # when no registry/tracer is installed (``metering`` covers both:
     # the span tracer consumes the same measurement).
     from ..telemetry import events as _tel_events
-    _meter = {"bytes": 0, "leaves": 0} if _tel_events.metering() else None
+    _meter = ({"bytes": 0, "wire": 0, "leaves": 0, "dtypes": set()}
+              if _tel_events.metering() else None)
     _t0 = time.perf_counter() if _meter is not None else None
 
     pre = 1.0
@@ -87,10 +131,25 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
     elif average:
         post = 1.0 / world
 
+    per_leaf = callable(scheme)
+    leaves, paths, treedef = _leaf_paths(grads, per_leaf)
+    if per_leaf:
+        specs = [_coll.resolve(s, min_bytes=min_compress_bytes)
+                 if (s := scheme(p, l)) is not None else None
+                 for p, l in zip(paths, leaves)]
+    else:
+        specs = [_coll.resolve(scheme, min_bytes=min_compress_bytes)
+                 ] * len(leaves)
+    res_leaves = (jax.tree_util.tree_leaves(residuals)
+                  if residuals is not None else [None] * len(leaves))
+
     from ..utils.pallas import _vma_of
 
-    def reduce_leaf(g):
+    def reduce_leaf(g, r, spec):
         orig_dtype = g.dtype
+        # upcast BEFORE the vma branch: a pre-summed low-precision leaf
+        # must apply its (pre*post) scaling in fp32 too, exactly as the
+        # pre-scheme code did
         if always_fp32 and orig_dtype != jnp.float32:
             g = g.astype(jnp.float32)
         vma = _vma_of(g)
@@ -100,24 +159,56 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
             scale = pre * post
             if scale != 1.0:
                 g = g * scale
-            return g.astype(orig_dtype)
+            return g.astype(orig_dtype), r
+        if spec is not None:
+            info = _coll.get_scheme(_coll.leaf_scheme(spec, g.size * 4))
+            eff = dataclasses.replace(spec, scheme=info.name)
+            x = g.astype(jnp.float32)
+            if pre != 1.0:
+                x = x * pre
+            if _meter is not None:
+                _meter["bytes"] += x.size * 4       # logical fp32 payload
+                _meter["wire"] += info.wire_bytes(x.size, eff.block)
+                _meter["leaves"] += 1
+                _meter["dtypes"].add(info.wire_dtype)
+            x, new_r = _coll.reduce(eff, x, axis_name, residual=r)
+            # adasum sets its own magnitude (between mean and sum): only
+            # the predivide pre-scale is undone; ``average`` is a no-op
+            p = ((predivide_factor or 1.0) if info.self_scaling else post)
+            if p != 1.0:
+                x = x * p
+            return x.astype(orig_dtype), (r if new_r is None else new_r)
         if pre != 1.0:
             g = g * pre
         if _meter is not None:
             # payload as reduced (post always_fp32 upcast): wire bytes
-            _meter["bytes"] += g.size * jnp.dtype(g.dtype).itemsize
+            nbytes = g.size * jnp.dtype(g.dtype).itemsize
+            _meter["bytes"] += nbytes
+            _meter["wire"] += nbytes
             _meter["leaves"] += 1
+            _meter["dtypes"].add(str(g.dtype))
         g = jax.lax.psum(g, axis_name)
         if post != 1.0:
             g = g * post
-        return g.astype(orig_dtype)
+        return g.astype(orig_dtype), r
 
-    reduced = jax.tree_util.tree_map(reduce_leaf, grads)
+    outs = [reduce_leaf(g, r, s)
+            for g, r, s in zip(leaves, res_leaves, specs)]
+    reduced = jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs])
     if _meter is not None:
-        _tel_events.record_collective(axis_name, int(_meter["bytes"]),
-                                      _meter["leaves"],
-                                      time.perf_counter() - _t0)
-    return reduced
+        dts = _meter["dtypes"]
+        _tel_events.record_collective(
+            axis_name, int(_meter["bytes"]), _meter["leaves"],
+            time.perf_counter() - _t0, wire_bytes=int(_meter["wire"]),
+            dtype=(next(iter(dts)) if len(dts) == 1 else
+                   "mixed" if dts else None),
+            scheme=(specs[0].scheme if specs and specs[0] is not None
+                    and not per_leaf else ("per_leaf" if per_leaf
+                                           else None)))
+    if residuals is None:
+        return reduced
+    new_res = jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs])
+    return reduced, new_res
 
 
 class DistributedDataParallel:
@@ -149,6 +240,8 @@ class DistributedDataParallel:
                  allreduce_communicators: Optional[Any] = None,
                  gradient_average: bool = True,
                  gradient_predivide_factor: Optional[float] = None,
+                 collective_scheme=None,
+                 collective_min_bytes: Optional[int] = None,
                  prof: bool = False):
         if shared_param is not None:
             # same deprecation as distributed.py:178-181
@@ -171,6 +264,10 @@ class DistributedDataParallel:
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
+        # compressed/adaptive collective scheme, resolved per-bucket at
+        # trace time (parallel.collectives; None = env/tuning/legacy)
+        self.collective_scheme = collective_scheme
+        self.collective_min_bytes = collective_min_bytes
         self.prof = prof
 
     # -- forward -------------------------------------------------------------
@@ -192,15 +289,25 @@ class DistributedDataParallel:
             lambda p: jax.device_put(p, sharding), params)
 
     # -- gradient reduction --------------------------------------------------
-    def allreduce_grads(self, grads):
+    def allreduce_grads(self, grads, residuals=None):
         """Reduce a gradient pytree over the data axis (the sum of all of
         ``allreduce_bucket``/``allreduce_fallback``/``comm_ready_buckets``,
-        distributed.py:426-557, expressed as one psum)."""
+        distributed.py:426-557, expressed as one psum).  ``residuals``
+        threads the int8 error-feedback state (see ``allreduce_tree``);
+        when passed, returns ``(grads, new_residuals)``."""
         return allreduce_tree(
             grads, axis_name=self.axis_name,
             average=self.gradient_average,
             predivide_factor=self.gradient_predivide_factor,
-            always_fp32=self.allreduce_always_fp32)
+            always_fp32=self.allreduce_always_fp32,
+            scheme=self.collective_scheme, residuals=residuals,
+            min_compress_bytes=self.collective_min_bytes)
+
+    def init_residuals(self, grads):
+        """Zero error-feedback residual pytree to carry in step state
+        when ``collective_scheme="int8_blockscale"``."""
+        from . import collectives
+        return collectives.init_residuals(grads)
 
     def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
         """Convenience: returns ``grad_fn`` with the reduction fused after it."""
@@ -220,11 +327,17 @@ class Reducer:
     ``average=True``; kept as its own class for API parity."""
 
     def __init__(self, module_or_grads_fn=None, *, axis_name: str = DATA_AXIS,
-                 gradient_average: bool = True):
+                 gradient_average: bool = True, collective_scheme=None,
+                 collective_min_bytes: Optional[int] = None):
         self.module = module_or_grads_fn
         self.axis_name = axis_name
         self.gradient_average = gradient_average
+        self.collective_scheme = collective_scheme
+        self.collective_min_bytes = collective_min_bytes
 
-    def reduce(self, grads):
+    def reduce(self, grads, residuals=None):
         return allreduce_tree(grads, axis_name=self.axis_name,
-                              average=self.gradient_average)
+                              average=self.gradient_average,
+                              scheme=self.collective_scheme,
+                              residuals=residuals,
+                              min_compress_bytes=self.collective_min_bytes)

@@ -38,6 +38,11 @@ Fault kinds and their consumers:
   * ``collective_fail`` — :func:`wrap_collective` raises
     :class:`CollectiveFault` on the scheduled *call index* (collectives
     fire at trace time under jit, so the index counts wrapper calls).
+    The compressed/adaptive collective schemes
+    (``parallel.collectives``: int8_blockscale, adasum, and the ZeRO
+    compressed reduce-scatter/allgather) consult the same schedule
+    through ``collectives.chaos_gate`` at every scheme reduction, so
+    chaos tests exercise the quantized paths too.
   * ``oom`` — the guard raises a synthetic ``RESOURCE_EXHAUSTED``
     allocator failure (``telemetry.memory.synthetic_oom``, message
     shaped like a real XLA report) at the scheduled step, driving the
@@ -106,6 +111,9 @@ class FaultPlan:
     def reset(self) -> None:
         """Re-arm every spec (a fresh run over the same plan)."""
         self._fired = [0] * len(self.specs)
+        # the collectives chaos gate keys its per-entry-point call
+        # indices on the plan — a re-armed plan starts counting fresh
+        self.__dict__.pop("_scheme_calls", None)
 
     def fire(self, kind: str, step: int) -> Optional[FaultSpec]:
         """Consume and return the armed spec of ``kind`` scheduled at

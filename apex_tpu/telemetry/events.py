@@ -12,12 +12,17 @@ collective meters, loader queue gauges):
     ``amp.loss_scale_doubled`` events plus the ``amp.loss_scale`` gauge.
   * **DDP collectives** — ``parallel.distributed.allreduce_tree`` calls
     :func:`record_collective` with the payload bytes, leaf count and
-    host wall time of each reduction it builds.  Under ``jit`` the call
-    fires at *trace* time (the collective itself fuses into the step, so
-    bytes/calls are per-traced-program facts and the wall time is
-    dispatch cost); in eager/shard_map-debug use it is per-call.  The
-    on-device collective time belongs to the profiler, not this meter —
-    documented in docs/telemetry.md.
+    host wall time of each reduction it builds; the ZeRO
+    reduce-scatter/allgather paths report through the same hook
+    (``op=``).  With a compressed scheme selected
+    (``parallel.collectives``) the hook also carries the WIRE bytes,
+    payload dtype and scheme, feeding the
+    ``*_compressed_bytes``/``*_compression_ratio`` meters.  Under
+    ``jit`` the call fires at *trace* time (the collective itself fuses
+    into the step, so bytes/calls are per-traced-program facts and the
+    wall time is dispatch cost); in eager/shard_map-debug use it is
+    per-call.  The on-device collective time belongs to the profiler,
+    not this meter — documented in docs/telemetry.md.
   * **data loader** — ``data.loader.NativeLoader`` reports the consumer
     wait per batch and (python-ring path) the queue depth after each
     dequeue via :func:`record_loader`.
@@ -116,21 +121,48 @@ def observe_amp(reg, prev_state, new_state):
 # -- library hooks (no-ops without a default registry) -----------------------
 
 def record_collective(axis_name: str, nbytes: int, n_leaves: int,
-                      seconds: float) -> None:
-    """DDP collective meter: bytes reduced + wall time per
-    ``allreduce_tree``/``Reducer.reduce`` call.  See module docstring
-    for the trace-time semantics under jit."""
-    _trace.note_span("ddp.allreduce", seconds, axis=axis_name,
-                     bytes=int(nbytes), leaves=int(n_leaves))
+                      seconds: float, *, wire_bytes=None, dtype=None,
+                      scheme=None, op: str = "allreduce") -> None:
+    """Collective meter: bytes reduced + wall time per
+    ``allreduce_tree``/``Reducer.reduce`` call (``op="allreduce"``) and
+    per ZeRO collective (``op="reduce_scatter"``/``"allgather"``).  See
+    module docstring for the trace-time semantics under jit.
+
+    Compression accounting (docs/telemetry.md): ``nbytes`` is the
+    LOGICAL payload (what an uncompressed reduction would move);
+    ``wire_bytes`` is what the selected collective scheme actually
+    ships (defaults to ``nbytes`` — uncompressed).  ``dtype`` labels
+    the wire payload ("int8", "bfloat16", ... or "mixed"), ``scheme``
+    names the collective scheme.  Counters:
+    ``<family>.<op>_compressed_bytes`` accumulates the wire bytes and
+    the ``<family>.<op>_compression_ratio`` gauge carries the per-call
+    logical/wire ratio, so a run's compression win is provable from the
+    JSONL alone."""
+    wire = int(nbytes if wire_bytes is None else wire_bytes)
+    family = "ddp" if op == "allreduce" else "zero"
+    name = f"{family}.{op}"
+    extra = {}
+    if dtype is not None:
+        extra["dtype"] = str(dtype)
+    if scheme is not None:
+        extra["scheme"] = str(scheme)
+    _trace.note_span(name, seconds, axis=axis_name,
+                     bytes=int(nbytes), leaves=int(n_leaves),
+                     wire_bytes=wire, **extra)
     if not active():
         return
     reg = _default
-    reg.counter("ddp.allreduce_calls").add(1)
-    reg.counter("ddp.allreduce_bytes").add(nbytes)
-    reg.counter("ddp.allreduce_leaves").add(n_leaves)
-    reg.histogram("ddp.allreduce_host_ms").observe(seconds * 1e3)
-    reg.event("ddp.allreduce", axis=axis_name, bytes=int(nbytes),
-              leaves=int(n_leaves), host_ms=seconds * 1e3)
+    reg.counter(f"{name}_calls").add(1)
+    reg.counter(f"{name}_bytes").add(nbytes)
+    reg.counter(f"{name}_compressed_bytes").add(wire)
+    if op == "allreduce":
+        reg.counter("ddp.allreduce_leaves").add(n_leaves)
+    if wire:
+        reg.gauge(f"{name}_compression_ratio").set(nbytes / wire)
+    reg.histogram(f"{name}_host_ms").observe(seconds * 1e3)
+    reg.event(name, axis=axis_name, bytes=int(nbytes),
+              leaves=int(n_leaves), host_ms=seconds * 1e3,
+              wire_bytes=wire, **extra)
 
 
 def record_loader(depth: Optional[int], wait_seconds: float) -> None:

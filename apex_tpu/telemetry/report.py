@@ -91,14 +91,25 @@ def summarize(records: List[dict]) -> dict:
     mem_peak = gauge_max("mem.peak_bytes_in_use")
     if mem_peak is None:
         mem_peak = gauge_max("mem.compiled_peak_bytes")
+    # collective accounting spans the DDP allreduce and the ZeRO
+    # reduce-scatter/allgather meters; ``wire`` is what the selected
+    # collective scheme actually shipped (docs/telemetry.md) — absent
+    # compressed counters (pre-compression JSONLs) degrade to
+    # wire == logical
+    _coll_ops = ("ddp.allreduce", "zero.reduce_scatter", "zero.allgather")
+    coll_logical = sum(counter_final(f"{n}_bytes") for n in _coll_ops)
+    coll_wire = sum(counter_final(f"{n}_compressed_bytes")
+                    for n in _coll_ops) or coll_logical
     out = {
         "steps": steps,
         "step_time_ms": step_time,
         "overflow_events": len(events.get("amp.overflow", ())),
         "scale_doublings": len(events.get("amp.loss_scale_doubled", ())),
         "loss_scale": gauge_last("amp.loss_scale"),
-        "collective_bytes": counter_final("ddp.allreduce_bytes"),
-        "collective_calls": counter_final("ddp.allreduce_calls"),
+        "collective_bytes": coll_logical,
+        "collective_wire_bytes": coll_wire,
+        "collective_calls": sum(counter_final(f"{n}_calls")
+                                for n in _coll_ops),
         "loader_queue_depth": gauge_last("loader.queue_depth"),
         "loader_wait_ms": hist("loader.wait_ms"),
         # resilience lifecycle (docs/resilience.md): the guard emits
@@ -150,8 +161,15 @@ def format_summary(s: dict) -> str:
     lines.append(f"  scale doublings     {s['scale_doublings']}")
     if s["loss_scale"] is not None:
         lines.append(f"  final loss scale    {s['loss_scale']:.0f}")
-    lines.append(f"  collective bytes    {s['collective_bytes']:.0f} "
-                 f"({s['collective_calls']:.0f} calls)")
+    wire = s.get("collective_wire_bytes")
+    if wire is not None and wire != s["collective_bytes"]:
+        ratio = s["collective_bytes"] / wire if wire else 1.0
+        lines.append(f"  collective bytes    {s['collective_bytes']:.0f} "
+                     f"logical / {wire:.0f} wire ({ratio:.2f}x compression, "
+                     f"{s['collective_calls']:.0f} calls)")
+    else:
+        lines.append(f"  collective bytes    {s['collective_bytes']:.0f} "
+                     f"({s['collective_calls']:.0f} calls)")
     if s["loader_queue_depth"] is not None:
         lines.append(f"  loader queue depth  {s['loader_queue_depth']:.0f}"
                      f" (last)")

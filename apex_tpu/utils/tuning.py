@@ -18,6 +18,10 @@ winners, and every tunable default consults it at trace time:
   - layer-norm / MLP Pallas-vs-XLA choice (``layer_norm_use_pallas``,
     ``mlp_use_pallas``) via their ``use_pallas=None`` auto mode
   - the ZeRO optimizers' kernel impl (``zero_impl``) via ``impl=None``
+  - the DDP collective scheme (``ddp_collective_scheme`` +
+    ``collective_min_compress_bytes``) via
+    ``parallel.collectives.resolve`` — the measured winner of the
+    bench ``collectives`` A/B leg
 
 Precedence everywhere: explicit argument > env override > tuning
 profile > built-in default.  With no profile on disk nothing changes —
@@ -63,6 +67,13 @@ SCHEMA = {
     "layer_norm_use_pallas": _is_bool,
     "mlp_use_pallas": _is_bool,
     "zero_impl": lambda v: v in ("fused", "xla"),
+    # per-bucket collective scheme for the DDP allreduce path
+    # (parallel.collectives; consumed by collectives.resolve when no
+    # explicit arg / APEX_TPU_COLLECTIVES env is given) + the byte
+    # threshold below which leaves stay fp32
+    "ddp_collective_scheme": lambda v: v in ("fp32", "bf16",
+                                             "int8_blockscale", "adasum"),
+    "collective_min_compress_bytes": _is_block,
 }
 
 

@@ -627,6 +627,98 @@ def _bench_bert_e2e_at(on_tpu, cfg, batch, seq):
     return out
 
 
+def bench_collectives(on_tpu):
+    """Collective-scheme A/B microbench (ISSUE 7): per scheme x payload
+    size, the host cost of building+running a shard_map'd
+    ``allreduce_tree`` plus the STATIC wire-byte accounting the
+    telemetry compressed-bytes counters use.  The schema-valid
+    telemetry block embeds the REAL metered counters (the reductions
+    trace with a live registry installed), so the >=3.5x int8
+    compression claim is asserted from the same counters a training run
+    would emit.  The ``leg: collectives`` marker routes the
+    apply_perf_results audit to ``collective_violations`` (this leg has
+    no MFU/HBM story — its evidence is bytes and host ms)."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu import telemetry
+    from apex_tpu.parallel import collectives as coll
+    from apex_tpu.parallel.distributed import allreduce_tree
+    from apex_tpu.parallel.mesh import create_mesh, shard_map
+    from apex_tpu.telemetry import events as tel_events
+    from apex_tpu.telemetry import report as treport
+
+    n_dev = len(jax.devices())
+    mesh = create_mesh({"data": n_dev})
+    # per-DEVICE element counts (the payload the telemetry meter
+    # accounts per device); on TPU the top size is a realistic DDP
+    # bucket (32 MiB fp32 per device), on CPU small enough for tier-1
+    sizes = (1 << 16, 1 << 20, 1 << 23) if on_tpu else (1 << 12, 1 << 14)
+    schemes = ("fp32", "bf16", "int8_blockscale", "adasum")
+    out = {"leg": "collectives", "world": n_dev,
+           "payload_elems_per_device": list(sizes), "schemes": {}}
+
+    sink = telemetry.MemorySink()
+    reg = telemetry.Registry(sink=sink, flush_interval=0,
+                             rank0_only=False, run_id="bench", memory=False)
+    h = reg.histogram("step_time_ms")
+
+    def _ctr(name):
+        return int(reg.read().get(name) or 0)
+
+    prev = tel_events.set_default(reg)
+    try:
+        for name in schemes:
+            rows = {}
+            for n in sizes:
+                spec = coll.CollectiveSpec(scheme=name, min_bytes=0)
+                x = jnp.asarray(np.random.RandomState(0)
+                                .randn(n * n_dev).astype(np.float32))
+
+                def fn(xs, _spec=spec):
+                    return allreduce_tree({"g": xs}, scheme=_spec)["g"]
+                jf = jax.jit(shard_map(fn, mesh=mesh, in_specs=(P("data"),),
+                                       out_specs=P("data")))
+                _log(f"collectives leg: {name} n/device={n} ...")
+                # logical/wire bytes from the METERED counters around
+                # the trace — the leg's ratio is the exact accounting a
+                # training run's ddp.allreduce_compressed_bytes counter
+                # would report, not a side re-derivation that could
+                # drift from the shipped wire format
+                b_log = _ctr("ddp.allreduce_bytes")
+                b_wire = _ctr("ddp.allreduce_compressed_bytes")
+                t0 = time.perf_counter()
+                _sync(jf(x))                       # compile + first run
+                compile_ms = (time.perf_counter() - t0) * 1e3
+                logical = _ctr("ddp.allreduce_bytes") - b_log
+                wire = _ctr("ddp.allreduce_compressed_bytes") - b_wire
+                reps = 5
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    r = jf(x)
+                _sync(r)
+                exec_ms = (time.perf_counter() - t0) / reps * 1e3
+                rows[str(n)] = {
+                    "exec_ms": round(exec_ms, 3),
+                    "compile_ms": round(compile_ms, 1),
+                    "logical_bytes": logical, "wire_bytes": wire,
+                    "ratio": (round(logical / wire, 3) if wire else None)}
+            top = rows[str(sizes[-1])]
+            out["schemes"][name] = {
+                "host_ms": top["exec_ms"],
+                "logical_bytes": top["logical_bytes"],
+                "wire_bytes": top["wire_bytes"], "ratio": top["ratio"],
+                "by_size": rows}
+            h.observe(top["exec_ms"])
+            _log(f"collectives leg: {name} host {top['exec_ms']} ms, "
+                 f"ratio {top['ratio']}x")
+    finally:
+        tel_events.set_default(prev)
+    reg.flush()
+    out["telemetry"] = {"records": sink.records,
+                        "summary": treport.summarize(sink.records)}
+    return out
+
+
 def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     """The bench with optional span tracing: ``APEX_BENCH_TRACE=<path>``
     wraps every leg in a span and writes the Chrome-trace timeline on
@@ -771,6 +863,18 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
         flush("bert_e2e", detail["bert_e2e"])
     else:
         _log("skipping bert e2e leg (budget)")
+    gc.collect()
+    # collective-scheme A/B (ISSUE 7): wire bytes + host ms per scheme,
+    # with the compressed-bytes counters embedded as telemetry evidence
+    if budget_left() > 60:
+        try:
+            with _leg_span("collectives"):
+                detail["collectives"] = bench_collectives(on_tpu)
+        except Exception as err:
+            detail["collectives"] = {"error": repr(err)[:200]}
+        flush("collectives", detail["collectives"])
+    else:
+        _log("skipping collectives leg (budget)")
     gc.collect()
     # max-throughput BERT rung ladder (TPU only — the CPU stand-in says
     # nothing about the remat trade)
@@ -924,8 +1028,23 @@ def main():
     print(json.dumps(payload))
 
 
+def _collectives_main():
+    """``python bench.py --collectives``: ONLY the collective-scheme A/B
+    on the ambient backend, one JSON line — the cheap leg tpu_watch.sh
+    runs as its own stage (a scheme A/B fits a short tunnel window that
+    the full bench would waste)."""
+    from apex_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+    on_tpu = jax.default_backend() == "tpu"
+    print(json.dumps({"metric": "collectives_ab",
+                      "backend": jax.default_backend(),
+                      "collectives": bench_collectives(on_tpu)}))
+
+
 if __name__ == "__main__":
-    if "--inner" in sys.argv:
+    if "--collectives" in sys.argv:
+        _collectives_main()
+    elif "--inner" in sys.argv:
         _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:
         main()

@@ -370,6 +370,11 @@ def _stub_timings(bench, monkeypatch, wedge_at=None):
                            {"images_per_sec": 0.8, "batch": 4}))
     monkeypatch.setattr(bench, "bench_bert_e2e",
                         mk("bench_bert_e2e", {"step_ms": 2.0}))
+    monkeypatch.setattr(bench, "bench_collectives",
+                        mk("bench_collectives",
+                           {"leg": "collectives",
+                            "schemes": {"int8_blockscale":
+                                        {"host_ms": 1.0, "ratio": 3.88}}}))
 
 
 def test_run_bench_flushes_headline_incrementally(tmp_path, monkeypatch):
@@ -403,7 +408,8 @@ def test_run_bench_full_flush_sequence(tmp_path, monkeypatch):
     legs = read_legs(d)
     rn50_key = ("rn50" if jax.default_backend() == "tpu"
                 else "rn50_cpu_standin_resnet18")
-    assert set(legs) == {"headline", rn50_key, "bert_e2e"}
+    assert set(legs) == {"headline", rn50_key, "bert_e2e", "collectives"}
+    assert legs["collectives"]["data"]["leg"] == "collectives"
     assert legs["headline"]["data"]["complete"] is True
     assert legs["headline"]["data"]["winner"] == "fused_flat"
     assert payload["value"] == 19.0

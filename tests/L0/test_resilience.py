@@ -162,6 +162,19 @@ def test_checkpoint_roundtrip_crc_framed(tmp_path):
     checkpoint.verify(p)                       # no raise
 
 
+def test_checkpoint_large_leaf_roundtrip(tmp_path):
+    """Regression: at pickle protocol 5, leaves past the ~64 KB framing
+    threshold reach the CRC writer as raw buffer-protocol objects
+    (PickleBuffer, no len()) — big-model checkpoints used to crash the
+    save.  The CRC frame must also verify/load back bit-exact."""
+    big = np.random.RandomState(0).randn(64 * 1024).astype(np.float32)
+    p = str(tmp_path / "big.ckpt")
+    checkpoint.save(p, step=1, w=big)
+    checkpoint.verify(p)                       # CRC covers the payload
+    got = checkpoint.load(p)
+    np.testing.assert_array_equal(got["w"], big)
+
+
 def test_checkpoint_load_truncated_raises_checkpoint_error(tmp_path):
     p = _write_ckpt(tmp_path / "t.ckpt")
     blob = open(p, "rb").read()

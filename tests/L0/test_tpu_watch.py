@@ -40,6 +40,9 @@ def run_watch(tmp_path, env_extra, timeout=60):
            # default mem sampler dials the backend (a jax import per
            # stage) — stub it off; the stage_mem test overrides it
            "APEX_WATCH_MEM_CMD": "",
+           # default collectives A/B runs a real jax bench — stub it
+           # off; the collectives-stage test overrides it
+           "APEX_WATCH_COLL_CMD": "",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
            **env_extra}
@@ -355,6 +358,54 @@ def test_stage_mem_counter_events_in_streaming_trace(tmp_path):
     assert r2.returncode == 0
     raw2 = (tmp_path / "WATCH_TRACE_empty.json").read_text()
     assert "watch.device_mem" not in raw2
+
+
+def test_collectives_ab_stage_artifact_and_span(tmp_path):
+    """ISSUE 7 satellite: the collectives A/B runs as its own watch
+    stage — artifact written atomically, span appended to the streaming
+    timeline, and the stage is skipped once the artifact exists."""
+    fake = json.dumps({"metric": "collectives_ab", "backend": "tpu",
+                       "collectives": {"leg": "collectives",
+                                       "schemes": {}}})
+    marker = tmp_path / "coll_calls"
+    r, log = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_COLL_CMD":
+            f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    art = json.loads((tmp_path / "COLLECTIVES_AB_r5.json").read_text())
+    assert art["collectives"]["leg"] == "collectives"
+    assert "collectives A/B done rc=0" in log
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.collectives_ab" in names
+    # second window: artifact present -> stage skipped
+    r2, _ = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_COLL_CMD":
+            f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+
+    # a failing A/B leaves no truncated artifact behind
+    r3, log3 = run_watch(tmp_path, {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_COLL_JSON": "COLL_FAIL.json",
+        "APEX_WATCH_COLL_CMD": "echo '{\"partial\":true'; false",
+    })
+    assert r3.returncode == 0
+    assert "collectives A/B done rc=1" in log3
+    assert not (tmp_path / "COLL_FAIL.json").exists()
+    assert not (tmp_path / "COLL_FAIL.json.run").exists()
 
 
 def test_stage_spans_record_failures_too(tmp_path):

@@ -177,6 +177,23 @@ def test_zero_impl_auto_uses_profile(profile, fake_tpu):
     assert DistributedFusedAdam(lr=1e-3, impl="xla").impl == "xla"
 
 
+def test_collective_scheme_resolve_uses_profile(profile, fake_tpu):
+    """ISSUE 7: the DDP collective scheme consults the measured profile
+    (TPU only, DDP key only) with the standard precedence."""
+    from apex_tpu.parallel import collectives
+    profile({"ddp_collective_scheme": "int8_blockscale",
+             "collective_min_compress_bytes": 2048})
+    spec = collectives.resolve(None)
+    assert spec is not None and spec.scheme == "int8_blockscale"
+    assert spec.min_bytes == 2048
+    # explicit arg beats the profile
+    assert collectives.resolve("adasum").scheme == "adasum"
+    # the ZeRO paths opt out of the DDP tuning key
+    assert collectives.resolve(None, tuning_key=None) is None
+    profile({})
+    assert collectives.resolve(None) is None
+
+
 def test_bert_config_attn_from_profile(profile, fake_tpu):
     from apex_tpu.models import bert_large_config
     profile({"bert_attn_impl": "fast"})
@@ -286,6 +303,35 @@ def test_decide_applies_rules():
     assert prof["zero_impl"] == "xla"          # lamb_stage1 lost
     assert prof["bert_attn_impl"] == "fast"    # mean(1.4,1.8,2.2) >= 1
     assert any("headline" in r[0] for r in rows)
+
+
+def test_decide_collective_scheme_from_ab_leg():
+    """The bench ``collectives`` A/B leg decides ddp_collective_scheme:
+    fastest measured scheme at the top payload; int8 is only eligible
+    with its >=3.5x wire ratio intact; a non-fp32 winner pins the
+    min-bytes threshold and the profile passes the committed schema."""
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    bench["detail"]["collectives"] = {
+        "leg": "collectives", "world": 8,
+        # adasum "fastest": it must still never be auto-selected — it
+        # changes the reduction rule, not just the wire format
+        "schemes": {"fp32": {"host_ms": 4.0, "ratio": 1.0},
+                    "bf16": {"host_ms": 2.4, "ratio": 2.0},
+                    "int8_blockscale": {"host_ms": 1.5, "ratio": 3.88},
+                    "adasum": {"host_ms": 0.9, "ratio": 1.0}}}
+    prof, rows = mod.decide(bench, kern)
+    assert prof["ddp_collective_scheme"] == "int8_blockscale"
+    assert prof["collective_min_compress_bytes"] == 4096
+    assert tuning.schema_violations(
+        {k: v for k, v in prof.items()}) == []
+    assert any("ddp_collective_scheme" in r[0] for r in rows)
+    # a drifted int8 ratio disqualifies it; the next-fastest wins
+    bench["detail"]["collectives"]["schemes"]["int8_blockscale"][
+        "ratio"] = 2.0
+    prof2, _ = mod.decide(bench, kern)
+    assert prof2["ddp_collective_scheme"] == "bf16"
+    assert any("ratio" in v for v in mod.collective_violations(bench))
 
 
 def test_decide_skips_cpu_tagged_kernels():
@@ -512,6 +558,12 @@ def test_schema_violations():
     assert tuning.schema_violations({"flash_block_q": -8})
     assert tuning.schema_violations({"flash_bwd_impl": "cuda"})
     assert tuning.schema_violations({"flash_bwd_fuse": 1})    # int != bool
+    # ISSUE 7: the per-bucket collective-scheme keys
+    assert tuning.schema_violations(
+        {"ddp_collective_scheme": "int8_blockscale",
+         "collective_min_compress_bytes": 4096}) == []
+    assert tuning.schema_violations({"ddp_collective_scheme": "zstd"})
+    assert tuning.schema_violations({"collective_min_compress_bytes": 0})
 
 
 def test_cli_schema_gate_blocks_drifted_profile(tmp_path, monkeypatch):

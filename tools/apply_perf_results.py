@@ -41,6 +41,13 @@ and TPU-backed; absent keys leave the built-in defaults untouched):
   layer_norm_use_pallas <- layer_norm_fwdbwd speedup > 1
   mlp_use_pallas        <- mlp_fwdbwd speedup > 1
   zero_impl             <- adam_update AND lamb_stage1 speedups > 1
+  ddp_collective_scheme <- the bench ``collectives`` A/B leg: fastest
+                           measured MEAN-SEMANTICS scheme at the
+                           largest payload (int8_blockscale only
+                           eligible with its >=3.5x wire ratio intact;
+                           adasum changes the reduction rule and is
+                           never auto-selected); a non-fp32 winner
+                           also pins collective_min_compress_bytes
 
 The headline flat-engine winner and vs_baseline are recorded in the
 table (informational — the optimizer ``impl`` is a user-facing state
@@ -142,7 +149,10 @@ def perf_field_violations(artifact) -> list:
         if not isinstance(node, dict):
             return
         tel = node.get("telemetry")
-        if isinstance(tel, dict) and node.get("_backend") in (None, "tpu"):
+        if isinstance(tel, dict) and node.get("_backend") in (None, "tpu") \
+                and node.get("leg") != "collectives":
+            # the collectives A/B leg carries byte/ms evidence, not
+            # MFU/HBM — collective_violations audits it instead
             recs = tel.get("records") or []
             gauges = {r.get("name") for r in recs
                       if isinstance(r, dict) and r.get("type") == "gauge"}
@@ -158,6 +168,51 @@ def perf_field_violations(artifact) -> list:
             if not has_mfu:
                 out.append(f"{path}: leg embeds telemetry but no MFU "
                            "field (mfu_pct / mfu_analytic_pct)")
+        for k, v in node.items():
+            if k != "telemetry":
+                walk(v, f"{path}.{k}")
+
+    walk(artifact if isinstance(artifact, dict) else {}, "artifact")
+    return out
+
+
+def collective_violations(artifact) -> list:
+    """Audit for the bench ``collectives`` A/B leg (ISSUE 7 satellite):
+    the leg must embed schema-valid telemetry whose counters carry the
+    compressed-bytes evidence, and the int8_blockscale row must show
+    the >=3.5x wire reduction the acceptance criterion demands — a leg
+    that 'measured' int8 without the byte win has drifted from the
+    scheme's wire format.  Warnings only, same posture as the other
+    audits."""
+    out = []
+
+    def walk(node, path):
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+            return
+        if not isinstance(node, dict):
+            return
+        if node.get("leg") == "collectives" and isinstance(
+                node.get("schemes"), dict):
+            schemes = node["schemes"]
+            if not isinstance(node.get("telemetry"), dict):
+                out.append(f"{path}: collectives leg embeds no telemetry")
+            else:
+                recs = node["telemetry"].get("records") or []
+                names = {r.get("name") for r in recs
+                         if isinstance(r, dict)}
+                if "ddp.allreduce_compressed_bytes" not in names:
+                    out.append(f"{path}: collectives telemetry carries "
+                               "no ddp.allreduce_compressed_bytes counter")
+            int8 = schemes.get("int8_blockscale")
+            if not isinstance(int8, dict):
+                out.append(f"{path}: collectives leg has no "
+                           "int8_blockscale row")
+            elif not (isinstance(int8.get("ratio"), (int, float))
+                      and int8["ratio"] >= 3.5):
+                out.append(f"{path}: int8_blockscale compression ratio "
+                           f"{int8.get('ratio')!r} < 3.5")
         for k, v in node.items():
             if k != "telemetry":
                 walk(v, f"{path}.{k}")
@@ -349,6 +404,43 @@ def decide(bench, kern):
                              f"fused_flat {det.get('fused_flat_impl_ms')} ms; "
                              f"optax {det.get('optax_baseline_ms')} ms; "
                              f"vs_baseline {bench.get('vs_baseline')}"))
+        coll = det.get("collectives")
+        if isinstance(coll, dict) \
+                and coll.get("_backend") in (None, "tpu") \
+                and isinstance(coll.get("schemes"), dict):
+            # ddp_collective_scheme <- fastest measured scheme at the
+            # largest payload, among the MEAN-SEMANTICS schemes only:
+            # adasum is a different reduction rule (self-scaling;
+            # gradient_average stops applying), so a host-ms win must
+            # never auto-change training semantics — it stays explicit
+            # opt-in.  int8 is only eligible when its measured wire
+            # ratio actually delivers the >=3.5x the convergence proof
+            # (tests/L0/test_collectives.py A/B) was run at — otherwise
+            # the leg drifted from the committed wire format
+            cand = {}
+            for name, row in coll["schemes"].items():
+                if name == "adasum":
+                    continue
+                ms = row.get("host_ms") if isinstance(row, dict) else None
+                if not isinstance(ms, (int, float)):
+                    continue
+                if name == "int8_blockscale" and not (
+                        isinstance(row.get("ratio"), (int, float))
+                        and row["ratio"] >= 3.5):
+                    continue
+                cand[name] = ms
+            if cand:
+                best = min(cand, key=cand.get)
+                prof["ddp_collective_scheme"] = best
+                if best != "fp32":
+                    # collectives.DEFAULT_MIN_BYTES (kept literal: this
+                    # CLI never imports jax); small/precision-critical
+                    # leaves stay fp32 under the measured scheme
+                    prof["collective_min_compress_bytes"] = 4096
+                rows.append(("ddp_collective_scheme", best,
+                             "collectives A/B host ms: " + ", ".join(
+                                 f"{k} {v}" for k, v in
+                                 sorted(cand.items()))))
 
     return prof, rows
 
@@ -388,6 +480,10 @@ def main(argv=None):
         # stand-ins honestly carry no MFU, so they are not audited)
         if isinstance(art, dict) and art.get("backend") in ("tpu", "mixed"):
             for v in perf_field_violations(art):
+                print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
+            # the collectives A/B leg has its own evidence contract
+            # (compressed-bytes counters + the >=3.5x int8 ratio)
+            for v in collective_violations(art):
                 print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
 
     prof, rows = decide(bench, kern)

@@ -85,6 +85,14 @@ GTRAIN_CMD=${APEX_WATCH_GTRAIN_CMD:-"python examples/imagenet/main_amp.py --arch
 GTRAIN_LOG=${APEX_WATCH_GTRAIN_LOG:-TRAIN_GUARD_r5.txt}
 GTRAIN_TO=${APEX_WATCH_GTRAIN_TO:-900}
 GTRAIN_DONE=${APEX_WATCH_GTRAIN_DONE:-TRAIN_GUARD_DONE}
+# stage 2b: collective-scheme A/B (fp32 vs bf16/int8/adasum wire bytes +
+# host ms, ISSUE 7) — cheap enough for a short window, and the artifact
+# feeds apply_perf_results' ddp_collective_scheme decision
+# ${VAR-default} (not :-): an explicitly EMPTY override disables the
+# stage (the [ -n ] gate below), rather than falling back to the default
+COLL_CMD=${APEX_WATCH_COLL_CMD-"python bench.py --collectives"}
+COLL_JSON=${APEX_WATCH_COLL_JSON:-COLLECTIVES_AB_r5.json}
+COLL_TO=${APEX_WATCH_COLL_TO:-300}
 INTEROP_CMD=${APEX_WATCH_INTEROP_CMD:-"python tools/bench_interop.py"}
 INTEROP_JSON=${APEX_WATCH_INTEROP_JSON:-INTEROP_r5.json}
 INTEROP_TO=${APEX_WATCH_INTEROP_TO:-600}
@@ -218,6 +226,21 @@ for i in $(seq 1 "$N_PROBES"); do
         sleep "$SLEEP"
         continue
       fi
+    fi
+    # ---- stage 2b: collective-scheme A/B (best-effort, short) ----
+    if [ -n "$COLL_CMD" ] && [ ! -s "$COLL_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$COLL_TO" bash -c "$COLL_CMD" > "$COLL_JSON".run 2>> "$LOG"
+      rcc=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span collectives_ab "$t0" "$rcc"
+      stage_mem
+      if [ $rcc -eq 0 ] && [ -s "$COLL_JSON".run ]; then
+        mv "$COLL_JSON".run "$COLL_JSON"
+      else
+        # a wedged/failed A/B never leaves a truncated artifact behind
+        rm -f "$COLL_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) collectives A/B done rc=$rcc" >> "$LOG"
     fi
     # ---- stage 3a: guard-driven resumable train (incremental) ----
     # BEFORE the all-or-nothing save/resume leg: the guard leg makes
