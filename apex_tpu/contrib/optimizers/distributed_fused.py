@@ -207,46 +207,15 @@ class _DistributedFusedBase:
             return g_shard, residual
 
         info = _coll.get_scheme(spec.scheme)
-        _coll.chaos_gate(f"zero.reduce_scatter.{info.name}")
         x = flat_g.astype(jnp.float32)
         if self.predivide and not info.self_scaling:
             x = x * (1.0 / world)
-        per = x.shape[0] // world_s
-        new_residual = residual
-        if spec.scheme == "int8_blockscale":
-            block = spec.block
-            if per % block:
-                raise ValueError(
-                    f"int8_blockscale reduce-scatter needs block "
-                    f"({block}) to divide the shard length ({per}); use "
-                    f"a block that divides total/{world_s}")
-            if residual is not None:
-                x = x + residual
-            q, scales = _coll.quantize_blockscale(x, block)
-            if residual is not None:
-                new_residual = x - _coll.dequantize_blockscale(
-                    q, scales, x.shape[0])
-            nb_per = per // block
-            qt = jax.lax.all_to_all(q.reshape(world_s, nb_per, block),
-                                    self.shard_axis, 0, 0)
-            st = jax.lax.all_to_all(scales.reshape(world_s, nb_per),
-                                    self.shard_axis, 0, 0)
-            g_shard = jnp.sum(qt.astype(jnp.float32) * st[..., None],
-                              axis=0).reshape(per)
-        elif spec.scheme == "bf16":
-            xt = jax.lax.all_to_all(
-                x.astype(jnp.bfloat16).reshape(world_s, per),
-                self.shard_axis, 0, 0)
-            g_shard = jnp.sum(xt.astype(jnp.float32), axis=0)
-        elif spec.scheme == "adasum":
-            xt = jax.lax.all_to_all(x.reshape(world_s, per),
-                                    self.shard_axis, 0, 0)
-            g_shard = _coll.adasum_merge(xt)
-        else:
-            raise ValueError(
-                f"collective scheme {spec.scheme!r} has no ZeRO "
-                "reduce-scatter lowering (custom schemes ride the DDP "
-                "allreduce path)")
+        # the compressed exchange itself (all_to_all of the wire format +
+        # local dequant-sum) is the shared flat lowering — one
+        # implementation with the plain-DDP weight-update sharding path
+        g_shard, new_residual = _coll.reduce_scatter_flat(
+            x, self.shard_axis, spec, residual=residual,
+            label="zero.reduce_scatter")
         if self.replica_axis is not None:
             g_shard = jax.lax.psum(g_shard, self.replica_axis)
             if info.self_scaling:
@@ -269,57 +238,23 @@ class _DistributedFusedBase:
         n = _axis_sz(self.shard_axis)
         return jnp.zeros((self._flattener(params, n).total,), jnp.float32)
 
-    def _ag_invariant(self, x):
-        # all_gather_invariant: identical collective, but its output is
-        # *replicated* under the vma system (every device provably holds the
-        # same full buffer), which is what gathered params are — plain
-        # all_gather would force check_vma=False on every enclosing shard_map
-        try:
-            from jax._src.lax.parallel import all_gather_invariant
-            return all_gather_invariant(x, self.shard_axis, axis=0,
-                                        tiled=True)
-        except ImportError:  # pragma: no cover - older jax
-            return jax.lax.all_gather(x, self.shard_axis, axis=0,
-                                      tiled=True)
-
     def _allgather(self, p_shard):
         import time as _time
         from ...parallel import collectives as _coll
         spec = self._resolve_scheme("ag")
-        t0 = _time.perf_counter()
-        if spec is not None and spec.scheme == "int8_blockscale":
-            _coll.chaos_gate("zero.allgather.int8_blockscale")
-            x = p_shard.astype(jnp.float32)
-            if x.shape[0] % spec.block:
-                # a block that doesn't divide the shard would pad each
-                # shard before the gather, silently interleaving zeros
-                # into the flat buffer unflatten slices by fixed offsets
-                raise ValueError(
-                    f"int8_blockscale allgather needs block ({spec.block}) "
-                    f"to divide the shard length ({x.shape[0]})")
-            q, scales = _coll.quantize_blockscale(x, spec.block)
-            qg = self._ag_invariant(q)           # (world*nb, block)
-            sg = self._ag_invariant(scales)      # (world*nb,)
-            full = (qg.astype(jnp.float32) * sg[:, None]).reshape(-1)
-            self._meter("allgather", x.size * 4,
-                        _coll.wire_bytes("int8_blockscale", x.size,
-                                         spec.block),
-                        _time.perf_counter() - t0, "int8_blockscale",
-                        "int8")
-            return full
         if spec is not None and spec.scheme == "adasum":
             raise ValueError("adasum is a reduction rule; it has no "
                              "allgather meaning")
-        bf16 = (self.bf16_allgather
-                or (spec is not None and spec.scheme == "bf16"))
-        if bf16:
-            p_shard = p_shard.astype(jnp.bfloat16)
-        full = self._ag_invariant(p_shard).astype(jnp.float32)
-        nbytes = p_shard.size * jnp.dtype(p_shard.dtype).itemsize
-        self._meter("allgather", p_shard.size * 4, nbytes,
+        # legacy bf16_allgather knob folds into the scheme selection
+        # (identical wire: the "bf16" spec IS that knob as a scheme)
+        if self.bf16_allgather and (spec is None or spec.scheme == "fp32"):
+            spec = _coll.CollectiveSpec(scheme="bf16")
+        t0 = _time.perf_counter()
+        full, wire, wdtype = _coll.allgather_flat(
+            p_shard, self.shard_axis, spec, label="zero.allgather")
+        self._meter("allgather", p_shard.size * 4, wire,
                     _time.perf_counter() - t0,
-                    "bf16" if bf16 else (spec.scheme if spec else None),
-                    str(p_shard.dtype))
+                    spec.scheme if spec is not None else None, wdtype)
         return full
 
     def _global_sumsq(self, x_shard):

@@ -90,6 +90,15 @@ class FusedOptimizer:
     back at store) — only the STORAGE narrows, the reference trade-off of
     low-precision optimizer states.  Master params always stay fp32."""
 
+    #: The flat update is strictly per-element: a contiguous slice of the
+    #: flat buffers updates exactly like the full buffer, so weight-update
+    #: sharding (``parallel.weight_update``) can run ``step_flat`` on each
+    #: replica's 1/N slice unchanged.  Optimizers with cross-element
+    #: reductions in their flat math (LAMB's per-tensor trust ratios,
+    #: NovoGrad's per-tensor second moment) set this False and override
+    #: :meth:`step_flat_shard` with the cross-shard form.
+    elementwise_flat_update = True
+
     def __init__(self, lr, weight_decay=0.0, impl="xla", state_dtype=None):
         if impl not in ("xla", "fused"):
             raise ValueError(f"impl must be 'xla' or 'fused', got {impl!r}")
@@ -107,14 +116,25 @@ class FusedOptimizer:
         """Cast an fp32-computed moment to its storage dtype (no-op fp32)."""
         return x.astype(self.state_dtype)
 
-    def flattener_for(self, params) -> TreeFlattener:
+    def flattener_for(self, params, chunk=None) -> TreeFlattener:
+        """Packing plan for ``params``.  ``chunk`` pins the flat buffer's
+        padding quantum — ``parallel.weight_update`` passes ``LANE *
+        n_shards`` so the total divides evenly into whole-lane shards;
+        ``None`` keeps whatever plan is cached for this structure (or the
+        default chunk when building fresh), so ``init``/``step`` calls
+        that follow a chunk-pinned build reuse the pinned plan."""
         leaves, treedef = jax.tree_util.tree_flatten(params)
         key = (treedef, tuple(l.shape for l in leaves),
                tuple(jnp.dtype(l.dtype) for l in leaves))
-        if self._flattener is None or self._flattener_key != key:
+        rebuild = self._flattener is None or self._flattener_key != key
+        if not rebuild and chunk is not None \
+                and self._flattener.chunk != int(chunk):
+            rebuild = True
+        if rebuild:
             # rebuilt when the param set/shapes change (add_param_group analog,
             # _process_optimizer.py:469-489) — a retrace, not a runtime error
-            self._flattener = TreeFlattener(params)
+            self._flattener = (TreeFlattener(params) if chunk is None
+                               else TreeFlattener(params, chunk=int(chunk)))
             self._flattener_key = key
         return self._flattener
 
@@ -133,6 +153,21 @@ class FusedOptimizer:
         raise NotImplementedError(
             f"{type(self).__name__} has no fused impl" if self.impl != "fused"
             else f"{type(self).__name__}.step_flat not implemented")
+
+    def step_flat_shard(self, state, g_shard, *, shard, scale=1.0, lr=None):
+        """Sharded flat update (``parallel.weight_update``): ``state``'s
+        flat fields and ``g_shard`` hold this replica's contiguous 1/N
+        slice of the flat buffers; ``shard`` is a
+        :class:`~apex_tpu.parallel.weight_update.ShardContext` (axis name
+        + packing plan + psum'd per-tensor reductions) for optimizers
+        whose update spans shards.  The default covers every strictly
+        elementwise flat update — the slice IS the full math."""
+        if not self.elementwise_flat_update:
+            raise NotImplementedError(
+                f"{type(self).__name__} has cross-tensor reductions in its "
+                "flat update and no sharded override — weight-update "
+                "sharding needs a step_flat_shard implementation")
+        return self.step_flat(state, g_shard, scale=scale, lr=lr)
 
     def model_params(self, state, dtype=None):
         """Unpack the fused state's flat master into a param tree (the

@@ -27,6 +27,10 @@ class FusedLAMBState(NamedTuple):
 
 
 class FusedLAMB(FusedOptimizer):
+    #: per-tensor trust ratios + the global-grad-norm clip span shards:
+    #: the sharded path needs the cross-shard override below
+    elementwise_flat_update = False
+
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-6, weight_decay=0.01, amsgrad=False,
                  adam_w_mode=True, grad_averaging=True, set_grad_none=True,
@@ -132,10 +136,6 @@ class FusedLAMB(FusedOptimizer):
         faster than the XLA reduce; PERF_NOTES.md)."""
         count, lr, rc1, rc2 = self._prep(state, lr)
         inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
-        wd = jnp.asarray(self.weight_decay, jnp.float32)
-        b1, b2, eps = self.beta1, self.beta2, self.eps
-        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
-
         # l2norm is homogeneous (||c*x|| = c*||x||, inv_scale > 0): norm
         # the RAW grads (the kernel reads them in their original dtype —
         # half the bandwidth for bf16 grads) and fold unscale+clip into
@@ -146,6 +146,34 @@ class FusedLAMB(FusedOptimizer):
         gnorm = kernels.multi_tensor_l2norm(flat_grads) * inv_scale
         g = flat_grads.astype(jnp.float32) * (
             inv_scale * self._clip_coeff(gnorm))
+        return self._flat_update(state, g, self.flattener, count, lr,
+                                 rc1, rc2)
+
+    def step_flat_shard(self, state, g_shard, *, shard, scale=1.0, lr=None):
+        """Sharded two-stage LAMB (``parallel.weight_update``): the same
+        chain as :meth:`step_flat` on this replica's 1/N slice — only
+        the reduction providers differ: the global-grad-norm clip and
+        the per-tensor ``(w, u)`` norms span shards, so they come from
+        the shard context's psum'd partial reductions (the
+        ``DistributedFusedLAMB`` stage-2 scheme)."""
+        count, lr, rc1, rc2 = self._prep(state, lr)
+        inv_scale = 1.0 / jnp.asarray(scale, jnp.float32)
+        gnorm = jnp.sqrt(shard.global_sumsq(g_shard)) * inv_scale
+        g = g_shard.astype(jnp.float32) * (
+            inv_scale * self._clip_coeff(gnorm))
+        return self._flat_update(state, g, shard, count, lr, rc1, rc2)
+
+    def _flat_update(self, state, g, reducer, count, lr, rc1, rc2):
+        """Stage 1+2 over flat buffers (full or shard-length): ``g`` is
+        the unscaled+clipped fp32 gradient buffer matching the state's
+        flat fields; ``reducer`` provides
+        ``per_tensor_sumsq``/``broadcast_rows`` spanning the whole
+        model — the ``TreeFlattener``'s static row-range reductions or
+        the ``ShardContext``'s psum'd partials.  ONE chain, so an
+        update-math fix can never miss the sharded twin."""
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
         p = state.master
         if not self.adam_w_mode:
             g = g + wd * p
@@ -157,14 +185,13 @@ class FusedLAMB(FusedOptimizer):
         if self.adam_w_mode:
             u = u + wd * p
 
-        # stage 2: per-tensor trust ratios via static row-range reductions
-        fl = self.flattener
-        w_norm = jnp.sqrt(fl.per_tensor_sumsq(p))
-        u_norm = jnp.sqrt(fl.per_tensor_sumsq(u))
+        # stage 2: per-tensor trust ratios via the reducer
+        w_norm = jnp.sqrt(reducer.per_tensor_sumsq(p))
+        u_norm = jnp.sqrt(reducer.per_tensor_sumsq(u))
         ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         if not self.use_nvlamb and self.weight_decay == 0.0:
             ratio = jnp.ones_like(ratio)
-        ratio_rows = fl.broadcast_rows(ratio)                 # (rows,)
+        ratio_rows = reducer.broadcast_rows(ratio)            # (rows,)
         p_new = (p.reshape(-1, LANE)
                  - lr * ratio_rows[:, None] * u.reshape(-1, LANE))
         return FusedLAMBState(count, self._store_moment(m),

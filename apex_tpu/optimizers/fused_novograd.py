@@ -26,6 +26,10 @@ class FusedNovoGradState(NamedTuple):
 
 
 class FusedNovoGrad(FusedOptimizer):
+    #: v is a per-TENSOR norm — it spans shards; the sharded path uses
+    #: the cross-shard override below
+    elementwise_flat_update = False
+
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.95, 0.98),
                  eps=1e-8, weight_decay=0.0, amsgrad=False,
                  reg_inside_moment=False, grad_averaging=True, norm_type=2,
@@ -107,6 +111,25 @@ class FusedNovoGrad(FusedOptimizer):
         ``v`` becomes a (num_leaves,) vector); the elementwise chain runs over
         the permanently-flat buffers, fused by XLA into a single pass.
         """
+        return self._flat_update(state, flat_grads, self.flattener,
+                                 scale=scale, lr=lr)
+
+    def step_flat_shard(self, state, g_shard, *, shard, scale=1.0, lr=None):
+        """Sharded flat NovoGrad (``parallel.weight_update``): the same
+        chain as :meth:`step_flat` on this replica's 1/N slice of
+        ``m``/``master``; the per-tensor ``v`` (a (num_leaves,) vector —
+        tiny) stays replicated, computed from the shard context's
+        psum'd per-tensor reductions so every replica agrees on the
+        per-layer norms."""
+        return self._flat_update(state, g_shard, shard, scale=scale, lr=lr)
+
+    def _flat_update(self, state, flat_grads, reducer, *, scale, lr):
+        """The NovoGrad chain over flat buffers (full or shard-length):
+        ``reducer`` provides ``per_tensor_sumsq``/``per_tensor_maxabs``/
+        ``broadcast_rows`` spanning the whole model — the
+        ``TreeFlattener``'s static reductions or the ``ShardContext``'s
+        psum'd partials.  ONE chain, so an update-math fix can never
+        miss the sharded twin."""
         count = state.count + 1
         lr = jnp.asarray(resolve(lr if lr is not None else self.lr, count),
                          jnp.float32)
@@ -115,22 +138,21 @@ class FusedNovoGrad(FusedOptimizer):
         beta3 = 1.0 - self.beta1 if self.grad_averaging else 1.0
         first = state.count == 0
 
-        fl = self.flattener
         flat_g = flat_grads.astype(jnp.float32) * inv_scale
         flat_p = state.master
         b1, b2, eps = self.beta1, self.beta2, self.eps
 
         if self.norm_type == 2:
-            norm_val = fl.per_tensor_sumsq(flat_g)          # ||g||^2 per leaf
+            norm_val = reducer.per_tensor_sumsq(flat_g)     # ||g||^2 per leaf
         else:
-            norm_val = fl.per_tensor_maxabs(flat_g)
+            norm_val = reducer.per_tensor_maxabs(flat_g)
         ema = b2 * state.v + (1.0 - b2) * norm_val
         v_new = jnp.where(jnp.logical_and(first, not self.init_zero),
                           norm_val, ema)
         denom = (jnp.sqrt(v_new) + eps if self.norm_type == 2
                  else v_new + eps)
 
-        denom_rows = fl.broadcast_rows(denom)               # (rows,)
+        denom_rows = reducer.broadcast_rows(denom)          # (rows,)
         # padding rows broadcast 0 — guard so 0/0 can't seed NaNs into m
         denom_rows = jnp.where(denom_rows > 0, denom_rows, 1.0)
         gn = (flat_g.reshape(-1, LANE) / denom_rows[:, None]).reshape(-1)

@@ -20,6 +20,8 @@ from .mesh import (
 )
 from . import collectives
 from .collectives import CollectiveSpec
+from . import weight_update
+from .weight_update import ShardedUpdate
 from .distributed import DistributedDataParallel, Reducer, allreduce_tree
 from .sync_batchnorm import SyncBatchNorm, sync_batch_norm, batch_norm_stats
 from .sequence import (ring_attention, ulysses_attention,

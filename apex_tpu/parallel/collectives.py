@@ -7,9 +7,12 @@ buckets (``apex/parallel/distributed.py:51-58,241-244``); this module
 generalizes that into a pluggable registry of *collective schemes*,
 selectable per-bucket (per-leaf) through the DDP
 :func:`~apex_tpu.parallel.distributed.allreduce_tree` /
-:class:`~apex_tpu.parallel.distributed.Reducer` paths and through
+:class:`~apex_tpu.parallel.distributed.Reducer` paths, through
 ZeRO's reduce-scatter / allgather
-(``contrib/optimizers/distributed_fused.py``).
+(``contrib/optimizers/distributed_fused.py``), and through the plain-
+DDP weight-update sharding path (``parallel.weight_update`` — the
+shared :func:`reduce_scatter_flat` / :func:`allgather_flat` flat-buffer
+lowerings at the bottom of this module serve both).
 
 Built-in schemes
 ----------------
@@ -326,16 +329,17 @@ def adasum_merge(stacked):
     return vals[0]
 
 
-def _gather(x, axis_name):
+def _gather(x, axis_name, *, tiled: bool = False):
     """all_gather with a leading world axis, typed *invariant* where the
     jax supports it (every device provably holds the same stack — the
     replication fact check_vma needs, same pattern as the ZeRO param
-    allgather)."""
+    allgather).  ``tiled=True`` concatenates along axis 0 instead of
+    stacking (the flat-buffer allgather shape)."""
     try:
         from jax._src.lax.parallel import all_gather_invariant
-        return all_gather_invariant(x, axis_name, axis=0, tiled=False)
+        return all_gather_invariant(x, axis_name, axis=0, tiled=tiled)
     except ImportError:        # pragma: no cover - older jax
-        return jax.lax.all_gather(x, axis_name, axis=0, tiled=False)
+        return jax.lax.all_gather(x, axis_name, axis=0, tiled=tiled)
 
 
 # ---------------------------------------------------------------------------
@@ -390,6 +394,106 @@ register_scheme(SchemeInfo(
 register_scheme(SchemeInfo(
     name="adasum", reduce=_adasum_reduce, self_scaling=True,
     wire_bytes=lambda n, b: 4 * n))
+
+
+# ---------------------------------------------------------------------------
+# flat-buffer collectives shared by the sharded optimizer paths: ZeRO
+# (contrib.optimizers.distributed_fused) and plain-DDP weight-update
+# sharding (parallel.weight_update) exchange the same wire formats —
+# one lowering, two consumers.
+# ---------------------------------------------------------------------------
+
+def reduce_scatter_flat(x, axis_name, spec: Optional[CollectiveSpec] = None,
+                        *, residual=None, label: str = "reduce_scatter"):
+    """Sum-reduce-scatter a 1-D buffer over ``axis_name``: every device
+    contributes its full local buffer and receives its own contiguous
+    1/world slice of the element-wise axis sum.
+
+    ``spec`` None or ``fp32`` lowers to ``lax.psum_scatter`` (the legacy
+    path — no chaos gate, matching the uncompressed DDP psum);
+    compressed schemes ship their wire representation via ``all_to_all``
+    + a local dequant-sum, gated by :func:`chaos_gate` under
+    ``"<label>.<scheme>"``.  ``residual`` threads the int8
+    error-feedback state (full flat, fp32).  The caller owns all
+    pre/post scaling (predivide, gradient averaging) and metering.
+    Returns ``(shard, new_residual)``.
+    """
+    if spec is None or spec.scheme == "fp32":
+        return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                    tiled=True), residual
+    info = get_scheme(spec.scheme)
+    chaos_gate(f"{label}.{info.name}")
+    world = jax.lax.psum(1, axis_name)
+    per = x.shape[0] // world
+    new_residual = residual
+    if spec.scheme == "int8_blockscale":
+        block = spec.block
+        if per % block:
+            raise ValueError(
+                f"int8_blockscale reduce-scatter needs block ({block}) to "
+                f"divide the shard length ({per}); use a block that "
+                f"divides total/{world}")
+        if residual is not None:
+            x = x + residual
+        q, scales = quantize_blockscale(x, block)
+        if residual is not None:
+            new_residual = x - dequantize_blockscale(q, scales, x.shape[0])
+        nb_per = per // block
+        qt = jax.lax.all_to_all(q.reshape(world, nb_per, block),
+                                axis_name, 0, 0)
+        st = jax.lax.all_to_all(scales.reshape(world, nb_per),
+                                axis_name, 0, 0)
+        shard = jnp.sum(qt.astype(jnp.float32) * st[..., None],
+                        axis=0).reshape(per)
+    elif spec.scheme == "bf16":
+        xt = jax.lax.all_to_all(x.astype(jnp.bfloat16).reshape(world, per),
+                                axis_name, 0, 0)
+        shard = jnp.sum(xt.astype(jnp.float32), axis=0)
+    elif spec.scheme == "adasum":
+        xt = jax.lax.all_to_all(x.reshape(world, per), axis_name, 0, 0)
+        shard = adasum_merge(xt)
+    else:
+        raise ValueError(
+            f"collective scheme {spec.scheme!r} has no reduce-scatter "
+            "lowering (custom schemes ride the DDP allreduce path)")
+    return shard, new_residual
+
+
+def allgather_flat(x, axis_name, spec: Optional[CollectiveSpec] = None,
+                   *, label: str = "allgather"):
+    """Gather a 1-D fp32 shard into the full concatenated fp32 buffer
+    (invariant all_gather — every device provably holds the same
+    result).  ``spec`` ``bf16`` ships bf16; ``int8_blockscale`` ships
+    the block-quantized (codes, scales) pair and dequantizes on arrival
+    (gated by :func:`chaos_gate` under ``"<label>.int8_blockscale"``);
+    ``adasum`` has no allgather meaning and raises.  Returns ``(full,
+    wire_bytes_per_device, wire_dtype)`` — the caller meters.
+    """
+    if spec is not None and spec.scheme == "adasum":
+        raise ValueError("adasum is a reduction rule; it has no "
+                         "allgather meaning")
+    if spec is not None and spec.scheme == "int8_blockscale":
+        chaos_gate(f"{label}.int8_blockscale")
+        if x.shape[0] % spec.block:
+            # a block that doesn't divide the shard would pad each shard
+            # before the gather, silently interleaving zeros into the
+            # flat buffer unflatten slices by fixed offsets
+            raise ValueError(
+                f"int8_blockscale allgather needs block ({spec.block}) "
+                f"to divide the shard length ({x.shape[0]})")
+        xf = x.astype(jnp.float32)
+        q, scales = quantize_blockscale(xf, spec.block)
+        qg = _gather(q, axis_name, tiled=True)       # (world*nb, block)
+        sg = _gather(scales, axis_name, tiled=True)  # (world*nb,)
+        full = (qg.astype(jnp.float32) * sg[:, None]).reshape(-1)
+        return (full, wire_bytes("int8_blockscale", x.size, spec.block),
+                "int8")
+    if spec is not None and spec.scheme == "bf16":
+        y = x.astype(jnp.bfloat16)
+        return (_gather(y, axis_name, tiled=True).astype(jnp.float32),
+                2 * x.size, "bfloat16")
+    return (_gather(x, axis_name, tiled=True).astype(jnp.float32),
+            x.size * jnp.dtype(x.dtype).itemsize, str(x.dtype))
 
 
 def reduce(spec: CollectiveSpec, x, axis_name, *, residual=None):

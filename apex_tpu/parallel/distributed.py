@@ -34,7 +34,13 @@ Beyond the reference: per-bucket compressed/adaptive collective schemes
 residuals, Adasum adaptive merge), selected via ``collective_scheme=`` /
 ``APEX_TPU_COLLECTIVES`` / the tuning profile and metered as
 logical-vs-wire bytes by the telemetry collective counters.  See
-docs/parallel.md "Collective schemes".
+docs/parallel.md "Collective schemes".  And weight-update sharding
+(``parallel.weight_update``, arXiv:2004.13336): the opt-in
+``update_sharding="zero1"`` knob replaces allreduce + replicated
+update with reduce-scatter → 1/N flat-slice optimizer step →
+(optionally quantized) param allgather, cutting per-replica
+optimizer-state HBM and update FLOPs by 1/N — ``weight_update(opt)``
+below hands back the engine, or None when the knob resolves off.
 """
 from __future__ import annotations
 
@@ -242,6 +248,8 @@ class DistributedDataParallel:
                  gradient_predivide_factor: Optional[float] = None,
                  collective_scheme=None,
                  collective_min_bytes: Optional[int] = None,
+                 update_sharding: Optional[str] = None,
+                 allgather_scheme=None,
                  prof: bool = False):
         if shared_param is not None:
             # same deprecation as distributed.py:178-181
@@ -268,6 +276,17 @@ class DistributedDataParallel:
         # trace time (parallel.collectives; None = env/tuning/legacy)
         self.collective_scheme = collective_scheme
         self.collective_min_bytes = collective_min_bytes
+        # weight-update sharding (parallel.weight_update): "off" | "zero1";
+        # None resolves env APEX_TPU_UPDATE_SHARDING then the tuning
+        # profile's ddp_update_sharding at weight_update() time.  An
+        # invalid explicit value fails HERE, not at first step.
+        if update_sharding is not None:
+            from . import weight_update as _wu
+            _wu.resolve_mode(update_sharding)
+        self.update_sharding = update_sharding
+        # param-allgather scheme for the sharded update (explicit only —
+        # see weight_update._resolve_ag for the posture)
+        self.allgather_scheme = allgather_scheme
         self.prof = prof
 
     # -- forward -------------------------------------------------------------
@@ -309,6 +328,28 @@ class DistributedDataParallel:
         from . import collectives
         return collectives.init_residuals(grads)
 
+    # -- weight-update sharding (parallel.weight_update) ---------------------
+    def weight_update(self, optimizer, **kwargs):
+        """The opt-in zero1 path: returns a
+        :class:`~apex_tpu.parallel.weight_update.ShardedUpdate` wired
+        with this DDP's axis/averaging/collective settings, or **None**
+        when the resolved mode is ``"off"`` — the caller then keeps the
+        classic ``allreduce_grads`` + replicated-update path, which is
+        bitwise-unchanged by this knob.  Resolution: the constructor's
+        ``update_sharding`` > ``APEX_TPU_UPDATE_SHARDING`` >
+        tuning ``ddp_update_sharding`` (TPU only) > off."""
+        from . import weight_update as _wu
+        if _wu.resolve_mode(self.update_sharding) == "off":
+            return None
+        kwargs.setdefault("collective_scheme", self.collective_scheme)
+        kwargs.setdefault("collective_min_bytes", self.collective_min_bytes)
+        kwargs.setdefault("allgather_scheme", self.allgather_scheme)
+        kwargs.setdefault("gradient_predivide_factor",
+                          self.gradient_predivide_factor)
+        return _wu.ShardedUpdate(optimizer, axis_name=self.axis_name,
+                                 gradient_average=self.gradient_average,
+                                 **kwargs)
+
     def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
         """Convenience: returns ``grad_fn`` with the reduction fused after it."""
         def wrapped(*args, **kwargs):
@@ -328,12 +369,17 @@ class Reducer:
 
     def __init__(self, module_or_grads_fn=None, *, axis_name: str = DATA_AXIS,
                  gradient_average: bool = True, collective_scheme=None,
-                 collective_min_bytes: Optional[int] = None):
+                 collective_min_bytes: Optional[int] = None,
+                 update_sharding: Optional[str] = None):
         self.module = module_or_grads_fn
         self.axis_name = axis_name
         self.gradient_average = gradient_average
         self.collective_scheme = collective_scheme
         self.collective_min_bytes = collective_min_bytes
+        if update_sharding is not None:
+            from . import weight_update as _wu
+            _wu.resolve_mode(update_sharding)
+        self.update_sharding = update_sharding
 
     def reduce(self, grads, residuals=None):
         return allreduce_tree(grads, axis_name=self.axis_name,
@@ -341,3 +387,16 @@ class Reducer:
                               scheme=self.collective_scheme,
                               residuals=residuals,
                               min_compress_bytes=self.collective_min_bytes)
+
+    def weight_update(self, optimizer, **kwargs):
+        """Same opt-in zero1 factory as
+        :meth:`DistributedDataParallel.weight_update` (None = mode off,
+        keep calling :meth:`reduce` + a replicated update)."""
+        from . import weight_update as _wu
+        if _wu.resolve_mode(self.update_sharding) == "off":
+            return None
+        kwargs.setdefault("collective_scheme", self.collective_scheme)
+        kwargs.setdefault("collective_min_bytes", self.collective_min_bytes)
+        return _wu.ShardedUpdate(optimizer, axis_name=self.axis_name,
+                                 gradient_average=self.gradient_average,
+                                 **kwargs)

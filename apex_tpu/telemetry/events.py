@@ -122,11 +122,17 @@ def observe_amp(reg, prev_state, new_state):
 
 def record_collective(axis_name: str, nbytes: int, n_leaves: int,
                       seconds: float, *, wire_bytes=None, dtype=None,
-                      scheme=None, op: str = "allreduce") -> None:
+                      scheme=None, op: str = "allreduce",
+                      family: Optional[str] = None) -> None:
     """Collective meter: bytes reduced + wall time per
-    ``allreduce_tree``/``Reducer.reduce`` call (``op="allreduce"``) and
-    per ZeRO collective (``op="reduce_scatter"``/``"allgather"``).  See
-    module docstring for the trace-time semantics under jit.
+    ``allreduce_tree``/``Reducer.reduce`` call (``op="allreduce"``), per
+    ZeRO collective (``op="reduce_scatter"``/``"allgather"``), and per
+    DDP weight-update-sharding collective (``op="reduce_scatter"``/
+    ``"param_allgather"`` with ``family="ddp"`` —
+    ``parallel.weight_update``).  ``family`` prefixes the metric names;
+    it defaults to ``"ddp"`` for the allreduce and ``"zero"``
+    otherwise, preserving the historical names.  See module docstring
+    for the trace-time semantics under jit.
 
     Compression accounting (docs/telemetry.md): ``nbytes`` is the
     LOGICAL payload (what an uncompressed reduction would move);
@@ -139,7 +145,8 @@ def record_collective(axis_name: str, nbytes: int, n_leaves: int,
     logical/wire ratio, so a run's compression win is provable from the
     JSONL alone."""
     wire = int(nbytes if wire_bytes is None else wire_bytes)
-    family = "ddp" if op == "allreduce" else "zero"
+    if family is None:
+        family = "ddp" if op == "allreduce" else "zero"
     name = f"{family}.{op}"
     extra = {}
     if dtype is not None:
@@ -177,6 +184,21 @@ def record_loader(depth: Optional[int], wait_seconds: float) -> None:
     if depth is not None:
         reg.gauge("loader.queue_depth").set(depth)
         reg.histogram("loader.depth_samples").observe(depth)
+
+
+def record_update_sharding(state_bytes_per_replica: int,
+                           world: int) -> None:
+    """Weight-update-sharding gauges (``parallel.weight_update``):
+    optimizer-state bytes actually held per replica under the current
+    sharding, and the shard count — the 1/N memory win as a metered
+    fact (a static shape property read at trace time, so it costs one
+    attribute check with no registry installed)."""
+    if not active():
+        return
+    reg = _default
+    reg.gauge("ddp.opt_state_bytes_per_replica").set(
+        float(state_bytes_per_replica))
+    reg.gauge("ddp.update_shard_world").set(float(world))
 
 
 def record_ckpt(seconds: float, nbytes: int, reg=None) -> None:

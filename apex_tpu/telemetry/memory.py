@@ -81,6 +81,11 @@ _OPT_KEYS = ("master", "opt_state", "scaler", "moment", "exp_avg",
 _PARAM_KEYS = ("model_params", "param", "weight", "kernel", "embed")
 _BATCH_KEYS = ("token", "image", "label", "target", "batch", "input",
                "boost")
+#: a bare terminal ``.m`` / ``.v`` / ``['m']`` / ``['v']`` field — the
+#: fused/sharded optimizer-state moment buffers (``FusedAdamState.m``
+#: and the weight-update-sharding 1/N slices keypath exactly so);
+#: terminal-only, so ``vectors``/``m_tokens`` never false-positive
+_MOMENT_FIELD_RE = re.compile(r"(?:\.|\[')([mv])(?:'\])?$")
 
 
 def classify_arg(path: str) -> str:
@@ -95,6 +100,11 @@ def classify_arg(path: str) -> str:
         return "optimizer"
     if any(k in p for k in _PARAM_KEYS):
         return "params"
+    # the bare terminal-field heuristic ranks BELOW the explicit param
+    # names: a genuine model parameter literally keyed 'm'
+    # (model_params['m']) must stay params, not flip to optimizer
+    if _MOMENT_FIELD_RE.search(p):
+        return "optimizer"
     if any(k in p for k in _BATCH_KEYS) or p in ("x", "y"):
         return "batch"
     return "args"
@@ -386,16 +396,26 @@ def memory_table(fn, *args, static_argnums=(), donate_argnums=(),
 
 
 def memory_model(fn=None, *args, table: Optional[dict] = None,
-                 register: bool = True, **kwargs) -> dict:
+                 register: bool = True, update_sharding_world: int = 1,
+                 **kwargs) -> dict:
     """The compact per-class memory cost model the ROADMAP auto-parallel
     planner consumes (and the shape the OOM post-mortem embeds).  Pass a
     precomputed ``table`` or let it compile ``fn(*args)`` itself.
     ``register=True`` installs the result as the process attribution
     (:func:`set_attribution`), so a later OOM dump names where the
-    bytes were expected to go."""
+    bytes were expected to go.
+
+    ``update_sharding_world``: shard count of a weight-update-sharded
+    run (``parallel.weight_update``).  The liveness sweep attributes
+    GLOBAL shapes, so under sharding the optimizer class sums all
+    replicas' slices; ``optimizer_bytes_per_replica`` divides it back
+    to what one replica actually holds — the number the planner's HBM
+    fit check needs.  Default 1 = replicated (per-replica == total,
+    the classic DDP meaning)."""
     if table is None:
         table = memory_table(fn, *args, **kwargs)
     cls = table["by_class"]
+    world = max(1, int(update_sharding_world))
     model = {
         "peak_hbm_bytes": int(table["peak_bytes"]),
         "platform": table.get("platform", "?"),
@@ -403,6 +423,8 @@ def memory_model(fn=None, *args, table: Optional[dict] = None,
         "by_class": {k: int(v) for k, v in cls.items()},
         "params_bytes": int(cls.get("params", 0)),
         "optimizer_bytes": int(cls.get("optimizer", 0)),
+        "optimizer_bytes_per_replica": int(cls.get("optimizer", 0)) // world,
+        "update_sharding_world": world,
         "batch_bytes": int(cls.get("batch", 0)),
         "activations_bytes": int(cls.get("activations", 0)),
         "temps_bytes": int(cls.get("temps", 0)),

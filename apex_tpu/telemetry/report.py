@@ -91,12 +91,14 @@ def summarize(records: List[dict]) -> dict:
     mem_peak = gauge_max("mem.peak_bytes_in_use")
     if mem_peak is None:
         mem_peak = gauge_max("mem.compiled_peak_bytes")
-    # collective accounting spans the DDP allreduce and the ZeRO
-    # reduce-scatter/allgather meters; ``wire`` is what the selected
-    # collective scheme actually shipped (docs/telemetry.md) — absent
-    # compressed counters (pre-compression JSONLs) degrade to
+    # collective accounting spans the DDP allreduce, the ZeRO
+    # reduce-scatter/allgather meters, and the DDP weight-update-
+    # sharding reduce-scatter/param-allgather; ``wire`` is what the
+    # selected collective scheme actually shipped (docs/telemetry.md) —
+    # absent compressed counters (pre-compression JSONLs) degrade to
     # wire == logical
-    _coll_ops = ("ddp.allreduce", "zero.reduce_scatter", "zero.allgather")
+    _coll_ops = ("ddp.allreduce", "zero.reduce_scatter", "zero.allgather",
+                 "ddp.reduce_scatter", "ddp.param_allgather")
     coll_logical = sum(counter_final(f"{n}_bytes") for n in _coll_ops)
     coll_wire = sum(counter_final(f"{n}_compressed_bytes")
                     for n in _coll_ops) or coll_logical

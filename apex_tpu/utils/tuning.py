@@ -22,6 +22,10 @@ winners, and every tunable default consults it at trace time:
     ``collective_min_compress_bytes``) via
     ``parallel.collectives.resolve`` — the measured winner of the
     bench ``collectives`` A/B leg
+  - DDP weight-update sharding (``ddp_update_sharding`` +
+    ``ddp_update_allgather_scheme``) via
+    ``parallel.weight_update.resolve_mode`` — the measured winner of
+    the bench ``update_sharding`` A/B leg
 
 Precedence everywhere: explicit argument > env override > tuning
 profile > built-in default.  With no profile on disk nothing changes —
@@ -74,6 +78,14 @@ SCHEMA = {
     "ddp_collective_scheme": lambda v: v in ("fp32", "bf16",
                                              "int8_blockscale", "adasum"),
     "collective_min_compress_bytes": _is_block,
+    # weight-update sharding for plain DDP (parallel.weight_update):
+    # the measured winner of the bench ``update_sharding`` A/B leg
+    # (consumed by weight_update.resolve_mode when no explicit arg /
+    # APEX_TPU_UPDATE_SHARDING env is given), plus the param-allgather
+    # scheme the winning zero1 variant was measured with
+    "ddp_update_sharding": lambda v: v in ("off", "zero1"),
+    "ddp_update_allgather_scheme": lambda v: v in ("fp32", "bf16",
+                                                   "int8_blockscale"),
 }
 
 

@@ -719,6 +719,168 @@ def bench_collectives(on_tpu):
     return out
 
 
+def bench_update_sharding(on_tpu):
+    """Weight-update-sharding A/B (ISSUE 8): plain-DDP allreduce +
+    replicated fused-flat update ("off") vs reduce-scatter → 1/N
+    flat-slice update → param allgather ("zero1", plus the int8
+    allgather flavor) at a BERT-large-ish flat size.  Embeds
+    schema-valid telemetry carrying the NEW
+    ``ddp.reduce_scatter``/``ddp.param_allgather`` counters, the
+    ``ddp.opt_state_bytes_per_replica`` gauge and the leg's peak-HBM
+    fields, so ``apply_perf_results``' ``update_sharding_violations``
+    audit and its ``ddp_update_sharding`` decision rule read the same
+    accounting a training run would emit."""
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu import telemetry
+    from apex_tpu.multi_tensor_apply.flattener import LANE
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel.distributed import DistributedDataParallel
+    from apex_tpu.parallel.mesh import create_mesh, shard_map
+    from apex_tpu.parallel.weight_update import ShardedUpdate
+    from apex_tpu.telemetry import events as tel_events
+    from apex_tpu.telemetry import report as treport
+    from apex_tpu.utils.pallas import has_vma
+
+    n_dev = len(jax.devices())
+    mesh = create_mesh({"data": n_dev})
+    # BERT-large-ish flat size on TPU (the repo's 334M-param flat
+    # benchmark buffer); small enough for tier-1 on CPU
+    n_elems = 334_233_600 if on_tpu else (1 << 14)
+    params = {"w": jnp.zeros((n_elems,), jnp.float32)}
+    grads = {"w": 0.01 * jnp.ones((n_dev, n_elems), jnp.float32)}
+    pspec = {"w": P()}
+    gspec = {"w": P("data")}
+    vma_kw = {} if has_vma() else {"check_vma": False}
+
+    sink = telemetry.MemorySink()
+    reg = telemetry.Registry(sink=sink, flush_interval=0,
+                             rank0_only=False, run_id="bench",
+                             memory=False)
+    h = reg.histogram("step_time_ms")
+
+    def _ctr(name):
+        return int(reg.read().get(name) or 0)
+
+    def _time_step(jf, *args):
+        t0 = time.perf_counter()
+        state = jf(*args)
+        _sync(state)                       # compile + first run
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            state = jf(*args)
+        _sync(state)
+        return (time.perf_counter() - t0) / reps * 1e3, compile_ms
+
+    out = {"leg": "update_sharding", "world": n_dev, "n_elems": n_elems,
+           "modes": {}}
+    prev = tel_events.set_default(reg)
+    try:
+        # ---- off: today's path (allreduce + replicated step + select)
+        ddp = DistributedDataParallel(axis_name="data")
+        opt_off = FusedAdam(lr=1e-3, impl="fused")
+        # same chunk as the sharded layout so the byte comparison is
+        # layout-matched (default chunk pads small CPU buffers wide)
+        fl_off = opt_off.flattener_for(params, chunk=LANE * n_dev)
+        state_off = opt_off.init(params)
+        uspec = jax.tree_util.tree_map(lambda _: P(), state_off)
+
+        def body_off(state, g):
+            g = jax.tree_util.tree_map(lambda x: x[0], g)
+            g = ddp.allreduce_grads(g)
+            flat = fl_off.flatten(g)
+            ok = jnp.all(jnp.isfinite(flat)).astype(jnp.float32)
+            new_state = opt_off.step_flat(state, flat)
+            return jax.tree_util.tree_map(
+                lambda nw, old: jnp.where(ok > 0, nw, old),
+                new_state, state)
+
+        jf_off = jax.jit(shard_map(body_off, mesh=mesh,
+                                   in_specs=(uspec, gspec),
+                                   out_specs=uspec, **vma_kw))
+        _log(f"update_sharding leg: off n={n_elems} world={n_dev} ...")
+        off_ms, _ = _time_step(jf_off, state_off, grads)
+        off_bytes = int(sum(
+            l.size * jnp.dtype(l.dtype).itemsize
+            for l in jax.tree_util.tree_leaves(state_off)))
+        out["modes"]["off"] = {"step_ms": round(off_ms, 3),
+                               "opt_state_bytes_per_replica": off_bytes}
+        h.observe(off_ms)
+        del state_off
+        gc.collect()
+
+        # ---- zero1 (+ int8 allgather flavor)
+        mem_probe = None
+        for mode, ag in (("zero1", None),
+                         ("zero1_int8ag", "int8_blockscale")):
+            su = ShardedUpdate(FusedAdam(lr=1e-3, impl="fused"),
+                               axis_name="data", allgather_scheme=ag)
+            sspec = su.state_pspecs(params, n_dev)
+            init_s = jax.jit(shard_map(lambda p: su.init(p), mesh=mesh,
+                                       in_specs=(pspec,),
+                                       out_specs=sspec))
+
+            def body_s(state, g, p, _su=su):
+                g = jax.tree_util.tree_map(lambda x: x[0], g)
+                _, new_state = _su.step(state, g, p)
+                return new_state
+
+            jf = jax.jit(shard_map(body_s, mesh=mesh,
+                                   in_specs=(sspec, gspec, pspec),
+                                   out_specs=sspec, **vma_kw))
+            _log(f"update_sharding leg: {mode} ...")
+            rs_b0 = _ctr("ddp.reduce_scatter_bytes")
+            rs_w0 = _ctr("ddp.reduce_scatter_compressed_bytes")
+            ag_b0 = _ctr("ddp.param_allgather_bytes")
+            ag_w0 = _ctr("ddp.param_allgather_compressed_bytes")
+            state_s = init_s(params)
+            ms, _ = _time_step(jf, state_s, grads, params)
+            ag_b = _ctr("ddp.param_allgather_bytes") - ag_b0
+            ag_w = _ctr("ddp.param_allgather_compressed_bytes") - ag_w0
+            row = {
+                "step_ms": round(ms, 3),
+                "opt_state_bytes_per_replica": int(
+                    reg.read().get("ddp.opt_state_bytes_per_replica")
+                    or 0),
+                "rs_logical_bytes":
+                    _ctr("ddp.reduce_scatter_bytes") - rs_b0,
+                "rs_wire_bytes":
+                    _ctr("ddp.reduce_scatter_compressed_bytes") - rs_w0,
+                "ag_logical_bytes": ag_b, "ag_wire_bytes": ag_w,
+                "ag_ratio": round(ag_b / ag_w, 3) if ag_w else None,
+            }
+            out["modes"][mode] = row
+            h.observe(ms)
+            _log(f"update_sharding leg: {mode} {row['step_ms']} ms, "
+                 f"state/replica {row['opt_state_bytes_per_replica']} B")
+            if mode == "zero1":
+                mem_probe = (jf, (state_s, grads, params))
+            del state_s
+            gc.collect()
+
+        z_bytes = out["modes"]["zero1"]["opt_state_bytes_per_replica"]
+        out["opt_state_shrink"] = (round(off_bytes / z_bytes, 3)
+                                   if z_bytes else None)
+        # the leg's peak-HBM evidence (compiled footprint off-TPU, free
+        # allocator counters on TPU — the _mem_fields contract)
+        if mem_probe is not None:
+            out.update(_mem_fields(mem_probe[0], mem_probe[1]))
+        for src, dst in (
+                ("hbm_device_in_use_bytes", "mem.bytes_in_use"),
+                ("hbm_device_process_peak_bytes",
+                 "mem.peak_bytes_in_use"),
+                ("hbm_compiled_peak_bytes", "mem.compiled_peak_bytes")):
+            if out.get(src) is not None:
+                reg.gauge(dst).set(float(out[src]))
+    finally:
+        tel_events.set_default(prev)
+    reg.flush()
+    out["telemetry"] = {"records": sink.records,
+                        "summary": treport.summarize(sink.records)}
+    return out
+
+
 def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     """The bench with optional span tracing: ``APEX_BENCH_TRACE=<path>``
     wraps every leg in a span and writes the Chrome-trace timeline on
@@ -875,6 +1037,19 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
         flush("collectives", detail["collectives"])
     else:
         _log("skipping collectives leg (budget)")
+    gc.collect()
+    # weight-update-sharding A/B (ISSUE 8): off vs zero1 step time +
+    # optimizer-state bytes/replica, with the new ddp.reduce_scatter /
+    # ddp.param_allgather counters embedded as telemetry evidence
+    if budget_left() > 60:
+        try:
+            with _leg_span("update_sharding"):
+                detail["update_sharding"] = bench_update_sharding(on_tpu)
+        except Exception as err:
+            detail["update_sharding"] = {"error": repr(err)[:200]}
+        flush("update_sharding", detail["update_sharding"])
+    else:
+        _log("skipping update_sharding leg (budget)")
     gc.collect()
     # max-throughput BERT rung ladder (TPU only — the CPU stand-in says
     # nothing about the remat trade)
@@ -1041,9 +1216,24 @@ def _collectives_main():
                       "collectives": bench_collectives(on_tpu)}))
 
 
+def _update_sharding_main():
+    """``python bench.py --update-sharding``: ONLY the weight-update-
+    sharding A/B on the ambient backend, one JSON line — the cheap leg
+    tpu_watch.sh runs as its own stage 2c (it fits a short tunnel
+    window the full bench would waste)."""
+    from apex_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+    on_tpu = jax.default_backend() == "tpu"
+    print(json.dumps({"metric": "update_sharding_ab",
+                      "backend": jax.default_backend(),
+                      "update_sharding": bench_update_sharding(on_tpu)}))
+
+
 if __name__ == "__main__":
     if "--collectives" in sys.argv:
         _collectives_main()
+    elif "--update-sharding" in sys.argv:
+        _update_sharding_main()
     elif "--inner" in sys.argv:
         _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:

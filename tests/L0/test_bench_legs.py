@@ -375,6 +375,12 @@ def _stub_timings(bench, monkeypatch, wedge_at=None):
                            {"leg": "collectives",
                             "schemes": {"int8_blockscale":
                                         {"host_ms": 1.0, "ratio": 3.88}}}))
+    monkeypatch.setattr(bench, "bench_update_sharding",
+                        mk("bench_update_sharding",
+                           {"leg": "update_sharding", "world": 8,
+                            "opt_state_shrink": 7.9,
+                            "modes": {"off": {"step_ms": 2.0},
+                                      "zero1": {"step_ms": 1.5}}}))
 
 
 def test_run_bench_flushes_headline_incrementally(tmp_path, monkeypatch):
@@ -408,8 +414,10 @@ def test_run_bench_full_flush_sequence(tmp_path, monkeypatch):
     legs = read_legs(d)
     rn50_key = ("rn50" if jax.default_backend() == "tpu"
                 else "rn50_cpu_standin_resnet18")
-    assert set(legs) == {"headline", rn50_key, "bert_e2e", "collectives"}
+    assert set(legs) == {"headline", rn50_key, "bert_e2e", "collectives",
+                         "update_sharding"}
     assert legs["collectives"]["data"]["leg"] == "collectives"
+    assert legs["update_sharding"]["data"]["leg"] == "update_sharding"
     assert legs["headline"]["data"]["complete"] is True
     assert legs["headline"]["data"]["winner"] == "fused_flat"
     assert payload["value"] == 19.0

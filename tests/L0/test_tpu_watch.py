@@ -43,6 +43,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            # default collectives A/B runs a real jax bench — stub it
            # off; the collectives-stage test overrides it
            "APEX_WATCH_COLL_CMD": "",
+           # same for the weight-update-sharding A/B (stage 2c)
+           "APEX_WATCH_US_CMD": "",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
            **env_extra}
@@ -406,6 +408,53 @@ def test_collectives_ab_stage_artifact_and_span(tmp_path):
     assert "collectives A/B done rc=1" in log3
     assert not (tmp_path / "COLL_FAIL.json").exists()
     assert not (tmp_path / "COLL_FAIL.json.run").exists()
+
+
+def test_update_sharding_ab_stage_artifact_and_span(tmp_path):
+    """ISSUE 8 satellite: the weight-update-sharding A/B runs as watch
+    stage 2c — artifact written atomically, span appended to the
+    streaming timeline, skip-when-complete, and a failing leg leaves no
+    truncated artifact behind (mirror of stage 2b)."""
+    fake = json.dumps({"metric": "update_sharding_ab", "backend": "tpu",
+                       "update_sharding": {"leg": "update_sharding",
+                                           "modes": {}}})
+    marker = tmp_path / "us_calls"
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    }
+    r, log = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_US_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    art = json.loads(
+        (tmp_path / "UPDATE_SHARDING_AB_r5.json").read_text())
+    assert art["update_sharding"]["leg"] == "update_sharding"
+    assert "update_sharding A/B done rc=0" in log
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.update_sharding_ab" in names
+    # second window: artifact present -> stage skipped
+    r2, _ = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_US_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+
+    # a failing A/B leaves no truncated artifact behind
+    r3, log3 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_US_JSON": "US_FAIL.json",
+        "APEX_WATCH_US_CMD": "echo '{\"partial\":true'; false",
+    })
+    assert r3.returncode == 0
+    assert "update_sharding A/B done rc=1" in log3
+    assert not (tmp_path / "US_FAIL.json").exists()
+    assert not (tmp_path / "US_FAIL.json.run").exists()
 
 
 def test_stage_spans_record_failures_too(tmp_path):

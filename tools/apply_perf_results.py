@@ -48,6 +48,16 @@ and TPU-backed; absent keys leave the built-in defaults untouched):
                            adasum changes the reduction rule and is
                            never auto-selected); a non-fp32 winner
                            also pins collective_min_compress_bytes
+  ddp_update_sharding   <- the bench ``update_sharding`` A/B leg:
+                           "zero1" iff the fastest ELIGIBLE zero1
+                           variant is no slower than the off baseline
+                           (the 1/N optimizer-state shrink is then
+                           free); an int8-allgather variant is only
+                           eligible with its metered >=3.5x ratio
+                           intact (a drifted variant's timing must not
+                           elect zero1 for a config that won't be
+                           consumed), and when it wins it also pins
+                           ddp_update_allgather_scheme
 
 The headline flat-engine winner and vs_baseline are recorded in the
 table (informational — the optimizer ``impl`` is a user-facing state
@@ -150,9 +160,12 @@ def perf_field_violations(artifact) -> list:
             return
         tel = node.get("telemetry")
         if isinstance(tel, dict) and node.get("_backend") in (None, "tpu") \
-                and node.get("leg") != "collectives":
-            # the collectives A/B leg carries byte/ms evidence, not
-            # MFU/HBM — collective_violations audits it instead
+                and node.get("leg") not in ("collectives",
+                                            "update_sharding"):
+            # the collectives / update_sharding A/B legs carry byte+ms
+            # evidence, not MFU — their own audits
+            # (collective_violations / update_sharding_violations)
+            # check them instead
             recs = tel.get("records") or []
             gauges = {r.get("name") for r in recs
                       if isinstance(r, dict) and r.get("type") == "gauge"}
@@ -213,6 +226,66 @@ def collective_violations(artifact) -> list:
                       and int8["ratio"] >= 3.5):
                 out.append(f"{path}: int8_blockscale compression ratio "
                            f"{int8.get('ratio')!r} < 3.5")
+        for k, v in node.items():
+            if k != "telemetry":
+                walk(v, f"{path}.{k}")
+
+    walk(artifact if isinstance(artifact, dict) else {}, "artifact")
+    return out
+
+
+def update_sharding_violations(artifact) -> list:
+    """Audit for the bench ``update_sharding`` A/B leg (ISSUE 8
+    satellite): the leg must embed schema-valid telemetry whose
+    counters carry the new ``ddp.reduce_scatter``/``ddp.param_allgather``
+    byte evidence plus a peak-HBM gauge, the per-replica optimizer-state
+    shrink must actually track the world size (~1/N), and an int8
+    allgather row must show the >=3.5x wire win the scheme promises.
+    Warnings only, same posture as the other audits."""
+    out = []
+
+    def walk(node, path):
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+            return
+        if not isinstance(node, dict):
+            return
+        if node.get("leg") == "update_sharding" and isinstance(
+                node.get("modes"), dict):
+            tel = node.get("telemetry")
+            if not isinstance(tel, dict):
+                out.append(f"{path}: update_sharding leg embeds no "
+                           "telemetry")
+            else:
+                recs = tel.get("records") or []
+                names = {r.get("name") for r in recs
+                         if isinstance(r, dict)}
+                for need in ("ddp.reduce_scatter_bytes",
+                             "ddp.param_allgather_bytes",
+                             "ddp.opt_state_bytes_per_replica"):
+                    if need not in names:
+                        out.append(f"{path}: update_sharding telemetry "
+                                   f"carries no {need}")
+                if not any(isinstance(n, str) and n.startswith("mem.")
+                           for n in names):
+                    out.append(f"{path}: update_sharding telemetry "
+                               "carries no peak-HBM (mem.*) gauge")
+            world = node.get("world")
+            shrink = node.get("opt_state_shrink")
+            if isinstance(world, int) and world > 1:
+                if not (isinstance(shrink, (int, float))
+                        and shrink >= 0.75 * world):
+                    out.append(
+                        f"{path}: opt_state_shrink {shrink!r} does not "
+                        f"track world {world} (~1/N expected)")
+            for mode, row in node["modes"].items():
+                if "int8" in mode and isinstance(row, dict):
+                    ratio = row.get("ag_ratio")
+                    if not (isinstance(ratio, (int, float))
+                            and ratio >= 3.5):
+                        out.append(f"{path}: {mode} allgather ratio "
+                                   f"{ratio!r} < 3.5")
         for k, v in node.items():
             if k != "telemetry":
                 walk(v, f"{path}.{k}")
@@ -442,6 +515,51 @@ def decide(bench, kern):
                                  f"{k} {v}" for k, v in
                                  sorted(cand.items()))))
 
+        us = det.get("update_sharding")
+        if isinstance(us, dict) and us.get("_backend") in (None, "tpu") \
+                and isinstance(us.get("modes"), dict):
+            # ddp_update_sharding <- zero1 iff the fastest measured
+            # zero1 variant is no slower than the off baseline (the
+            # memory win is free then; a slower step stays opt-in).
+            # The winning variant's allgather scheme rides along ONLY
+            # with its metered >=3.5x ratio intact — otherwise the leg
+            # drifted from the committed wire format.
+            modes = us["modes"]
+            off_ms = (modes.get("off") or {}).get("step_ms")
+            # eligibility mirrors the ddp_collective_scheme rule: an
+            # int8-allgather variant whose metered ratio drifted below
+            # 3.5x would never have its scheme consumed, so its (faster)
+            # timing must not elect zero1 on the fp32 variant's behalf —
+            # filter ineligible variants out of the candidate set FIRST
+            zrows = {}
+            for m, r in modes.items():
+                if not (m.startswith("zero1") and isinstance(r, dict)
+                        and isinstance(r.get("step_ms"), (int, float))):
+                    continue
+                if "int8" in m and not (
+                        isinstance(r.get("ag_ratio"), (int, float))
+                        and r["ag_ratio"] >= 3.5):
+                    continue
+                zrows[m] = r
+            if isinstance(off_ms, (int, float)) and zrows:
+                best_z = min(zrows, key=lambda m: zrows[m]["step_ms"])
+                win = zrows[best_z]["step_ms"] <= off_ms
+                prof["ddp_update_sharding"] = "zero1" if win else "off"
+                rows.append((
+                    "ddp_update_sharding", prof["ddp_update_sharding"],
+                    f"A/B step ms: off {off_ms}, " + ", ".join(
+                        f"{m} {r['step_ms']}"
+                        for m, r in sorted(zrows.items()))
+                    + f"; opt-state shrink {us.get('opt_state_shrink')}x"))
+                if win and "int8" in best_z:
+                    prof["ddp_update_allgather_scheme"] = \
+                        "int8_blockscale"
+                    rows.append((
+                        "ddp_update_allgather_scheme",
+                        "int8_blockscale",
+                        f"winning variant's metered allgather "
+                        f"ratio {zrows[best_z]['ag_ratio']}x"))
+
     return prof, rows
 
 
@@ -484,6 +602,10 @@ def main(argv=None):
             # the collectives A/B leg has its own evidence contract
             # (compressed-bytes counters + the >=3.5x int8 ratio)
             for v in collective_violations(art):
+                print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
+            # so does the update_sharding A/B leg (reduce-scatter /
+            # param-allgather counters + the ~1/N state shrink)
+            for v in update_sharding_violations(art):
                 print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
 
     prof, rows = decide(bench, kern)

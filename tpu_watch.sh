@@ -93,6 +93,13 @@ GTRAIN_DONE=${APEX_WATCH_GTRAIN_DONE:-TRAIN_GUARD_DONE}
 COLL_CMD=${APEX_WATCH_COLL_CMD-"python bench.py --collectives"}
 COLL_JSON=${APEX_WATCH_COLL_JSON:-COLLECTIVES_AB_r5.json}
 COLL_TO=${APEX_WATCH_COLL_TO:-300}
+# stage 2c: weight-update-sharding A/B (off vs zero1 step time +
+# optimizer-state bytes/replica, ISSUE 8) — cheap like 2b, and the
+# artifact feeds apply_perf_results' ddp_update_sharding decision.
+# ${VAR-default} again: an explicitly EMPTY override disables the stage
+US_CMD=${APEX_WATCH_US_CMD-"python bench.py --update-sharding"}
+US_JSON=${APEX_WATCH_US_JSON:-UPDATE_SHARDING_AB_r5.json}
+US_TO=${APEX_WATCH_US_TO:-300}
 INTEROP_CMD=${APEX_WATCH_INTEROP_CMD:-"python tools/bench_interop.py"}
 INTEROP_JSON=${APEX_WATCH_INTEROP_JSON:-INTEROP_r5.json}
 INTEROP_TO=${APEX_WATCH_INTEROP_TO:-600}
@@ -241,6 +248,21 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$COLL_JSON".run
       fi
       echo "$(date +%H:%M:%S) collectives A/B done rc=$rcc" >> "$LOG"
+    fi
+    # ---- stage 2c: weight-update-sharding A/B (best-effort, short) ----
+    if [ -n "$US_CMD" ] && [ ! -s "$US_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$US_TO" bash -c "$US_CMD" > "$US_JSON".run 2>> "$LOG"
+      rcu=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span update_sharding_ab "$t0" "$rcu"
+      stage_mem
+      if [ $rcu -eq 0 ] && [ -s "$US_JSON".run ]; then
+        mv "$US_JSON".run "$US_JSON"
+      else
+        # a wedged/failed A/B never leaves a truncated artifact behind
+        rm -f "$US_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) update_sharding A/B done rc=$rcu" >> "$LOG"
     fi
     # ---- stage 3a: guard-driven resumable train (incremental) ----
     # BEFORE the all-or-nothing save/resume leg: the guard leg makes
