@@ -22,6 +22,8 @@ from . import collectives
 from .collectives import CollectiveSpec
 from . import weight_update
 from .weight_update import ShardedUpdate
+from . import plan
+from .plan import Plan
 from .distributed import DistributedDataParallel, Reducer, allreduce_tree
 from .sync_batchnorm import SyncBatchNorm, sync_batch_norm, batch_norm_stats
 from .sequence import (ring_attention, ulysses_attention,

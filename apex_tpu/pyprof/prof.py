@@ -31,19 +31,85 @@ CLI (profiles the flagship transformer train step, the analog of running
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Callable
 
 import jax
 
 # Per-chip ceilings used for the roofline projection when the caller does
-# not pass their own.  v5e: 197 bf16 TFLOP/s, 819 GB/s HBM (public figures;
-# jax-ml.github.io/scaling-book).  CPU gets a token entry so the report
-# stays meaningful in tests.
+# not pass their own.  Public figures (jax-ml.github.io/scaling-book):
+#   v4  275 bf16 TFLOP/s, 1228 GB/s HBM, 32 GB, ~45 GB/s/link ICI
+#   v5e 197 bf16 TFLOP/s,  819 GB/s HBM, 16 GB, ~45 GB/s/link ICI
+#   v5p 459 bf16 TFLOP/s, 2765 GB/s HBM, 95 GB, ~90 GB/s/link ICI
+# ``ici_bw`` is the one-way per-neighbor link bandwidth the planner's
+# alpha-beta collective model divides wire bytes by; ``ici_alpha_s`` the
+# per-hop launch latency; ``hbm_bytes`` the capacity its feasibility
+# check prunes against.  The generic "tpu" row keeps the v5e numbers
+# (the chip the r5 measurements ran on) so existing consumers are
+# unchanged; CPU gets token entries so reports/tests stay meaningful
+# (its "ici" is the host-memory shuffle an emulated mesh pays).
 HW_CEILINGS = {
-    "tpu": {"peak_flops": 197e12, "peak_bw": 819e9},
-    "cpu": {"peak_flops": 1e11, "peak_bw": 50e9},
-    "gpu": {"peak_flops": 1e14, "peak_bw": 1e12},
+    "tpu": {"peak_flops": 197e12, "peak_bw": 819e9,
+            "ici_bw": 45e9, "ici_alpha_s": 1e-6, "hbm_bytes": 16e9},
+    "tpu_v4": {"peak_flops": 275e12, "peak_bw": 1228e9,
+               "ici_bw": 45e9, "ici_alpha_s": 1e-6, "hbm_bytes": 32e9},
+    "tpu_v5e": {"peak_flops": 197e12, "peak_bw": 819e9,
+                "ici_bw": 45e9, "ici_alpha_s": 1e-6, "hbm_bytes": 16e9},
+    "tpu_v5p": {"peak_flops": 459e12, "peak_bw": 2765e9,
+                "ici_bw": 90e9, "ici_alpha_s": 1e-6, "hbm_bytes": 95e9},
+    # CPU models the 8-device EMULATED mesh tier-1 runs on, not the
+    # host's datasheet: effective bandwidth and per-collective launch
+    # cost are dominated by XLA's threaded emulation (calibrated
+    # against the measured flagship dp-family A/B in test_plan.py —
+    # the planner's relative predictions there land within ~15%)
+    "cpu": {"peak_flops": 1e11, "peak_bw": 2e10,
+            "ici_bw": 1e10, "ici_alpha_s": 5e-5, "hbm_bytes": 64e9},
+    "gpu": {"peak_flops": 1e14, "peak_bw": 1e12,
+            "ici_bw": 300e9, "ici_alpha_s": 1e-6, "hbm_bytes": 80e9},
 }
+
+#: every key a ceilings row may carry (the APEX_TPU_CEILINGS grammar
+#: rejects anything else — a typo'd override must fail loudly, not
+#: silently leave the generic row in place)
+CEILING_KEYS = ("peak_flops", "peak_bw", "ici_bw", "ici_alpha_s",
+                "hbm_bytes")
+
+ENV_CEILINGS = "APEX_TPU_CEILINGS"
+
+
+def resolve_ceilings(platform: str = "cpu") -> dict:
+    """The ceilings row for ``platform``, with the documented
+    ``APEX_TPU_CEILINGS`` override applied.  Grammar (comma-separated
+    tokens, applied left to right)::
+
+        APEX_TPU_CEILINGS="v5p"                      # named generation row
+        APEX_TPU_CEILINGS="peak_flops=2.75e14"       # key override
+        APEX_TPU_CEILINGS="v4,ici_bw=5e10"           # row, then override
+
+    A bare token names an ``HW_CEILINGS`` row (``v4``/``v5e``/``v5p``
+    shorthands resolve to their ``tpu_*`` rows); ``key=value`` tokens
+    override individual ceilings.  So planner/roofline predictions are
+    never pinned to the single generic "tpu" row: point the env at the
+    generation actually behind the tunnel."""
+    base = dict(HW_CEILINGS.get(platform, HW_CEILINGS["cpu"]))
+    spec = os.environ.get(ENV_CEILINGS, "").strip()
+    for tok in filter(None, (t.strip() for t in spec.split(","))):
+        if "=" in tok:
+            key, _, val = tok.partition("=")
+            key = key.strip()
+            if key not in CEILING_KEYS:
+                raise ValueError(
+                    f"{ENV_CEILINGS}: unknown ceiling {key!r} "
+                    f"(known: {CEILING_KEYS})")
+            base[key] = float(val)
+        else:
+            name = tok if tok in HW_CEILINGS else f"tpu_{tok}"
+            if name not in HW_CEILINGS:
+                raise ValueError(
+                    f"{ENV_CEILINGS}: unknown ceilings row {tok!r} "
+                    f"(known: {tuple(sorted(HW_CEILINGS))})")
+            base.update(HW_CEILINGS[name])
+    return base
 
 
 def _first(d: Any, *keys, default=0.0):
@@ -83,7 +149,7 @@ def cost_report(fn: Callable, *args,
         mem = None
 
     platform = jax.devices()[0].platform
-    ceil = HW_CEILINGS.get(platform, HW_CEILINGS["cpu"])
+    ceil = resolve_ceilings(platform)
     pf = peak_flops or ceil["peak_flops"]
     pb = peak_bw or ceil["peak_bw"]
 

@@ -319,6 +319,46 @@ def parse_hlo(text: str) -> List[dict]:
     return rows
 
 
+def collectives_table(rows) -> dict:
+    """Per-collective logical-byte sub-table from parsed HLO rows (the
+    ``class == "collective"`` bin) — the calibration surface for the
+    auto-parallel planner's alpha-beta comm model
+    (``parallel.plan``): modeled per-axis collective payloads can be
+    checked against what the compiled program actually exchanges, not
+    just parameter counts.
+
+    ``logical_bytes`` per op is ``max(in, out)`` — the full logical
+    payload regardless of which side holds it (an all-gather's input is
+    the 1/world shard, its output the full buffer; a reduce-scatter the
+    reverse; an all-reduce has both sides equal).  Compiled under SPMD
+    the shapes are per-partition, i.e. per-device payloads — exactly
+    what the planner's per-device wire model predicts."""
+    out_rows = []
+    by_opcode: Dict[str, dict] = {}
+    for r in rows:
+        if r["class"] != "collective":
+            continue
+        in_bytes = max(0.0, r["bytes"] - r["out_bytes"])
+        logical = max(in_bytes, r["out_bytes"])
+        out_rows.append({
+            "op": r["op"], "opcode": r["opcode"], "jax_op": r["jax_op"],
+            "in_bytes": in_bytes, "out_bytes": r["out_bytes"],
+            "logical_bytes": logical,
+        })
+        agg = by_opcode.setdefault(
+            r["opcode"], {"count": 0, "in_bytes": 0.0, "out_bytes": 0.0,
+                          "logical_bytes": 0.0})
+        agg["count"] += 1
+        agg["in_bytes"] += in_bytes
+        agg["out_bytes"] += r["out_bytes"]
+        agg["logical_bytes"] += logical
+    return {
+        "rows": out_rows,
+        "by_opcode": by_opcode,
+        "total_logical_bytes": sum(r["logical_bytes"] for r in out_rows),
+    }
+
+
 def _compiled_text(compiled) -> str:
     try:
         return compiled.as_text()
@@ -340,7 +380,7 @@ def op_table(fn: Callable, *args, static_argnums=(), donate_argnums=(),
     per-op roofline lower bound) and ``pct_flops``/``pct_bytes`` shares.
     """
     import jax
-    from ..pyprof.prof import HW_CEILINGS, _first
+    from ..pyprof.prof import resolve_ceilings, _first
 
     jitted = jax.jit(fn, static_argnums=static_argnums,
                      donate_argnums=donate_argnums)
@@ -355,7 +395,7 @@ def op_table(fn: Callable, *args, static_argnums=(), donate_argnums=(),
         cost = cost[0] if cost else None
 
     platform = jax.devices()[0].platform
-    ceil = HW_CEILINGS.get(platform, HW_CEILINGS["cpu"])
+    ceil = resolve_ceilings(platform)
     pf = peak_flops or ceil["peak_flops"]
     pb = peak_bw or ceil["peak_bw"]
 
@@ -390,6 +430,7 @@ def op_table(fn: Callable, *args, static_argnums=(), donate_argnums=(),
     return {
         "platform": platform,
         "rows": rows,
+        "collectives": collectives_table(rows),
         "by_opcode": by_opcode,
         "by_class": by_class,
         "total_flops": total_flops,
@@ -431,6 +472,16 @@ def format_op_table(table: dict, top: int = 20) -> str:
         rest_b = sum(r["bytes"] for r in rows[top:])
         lines.append(f"{'... ' + str(len(rows) - top) + ' more ops':<49} "
                      f"{_human(rest_f):>10} {_human(rest_b):>10}")
+    coll = table.get("collectives") or {}
+    if coll.get("rows"):
+        lines.append("per-collective logical bytes (planner comm-model "
+                     "calibration)")
+        for opcode, agg in sorted(coll["by_opcode"].items()):
+            lines.append(
+                f"  {opcode:<32} {agg['count']:>4} ops   "
+                f"in {_human(agg['in_bytes'], 'B'):>10} "
+                f"out {_human(agg['out_bytes'], 'B'):>10} "
+                f"logical {_human(agg['logical_bytes'], 'B'):>10}")
     by_class = table.get("by_class") or {}
     if by_class:
         lines.append("per-class rollup (pyprof prof/ vocabulary)")

@@ -429,6 +429,11 @@ def memory_model(fn=None, *args, table: Optional[dict] = None,
         "activations_bytes": int(cls.get("activations", 0)),
         "temps_bytes": int(cls.get("temps", 0)),
         "output_bytes": int(cls.get("output", 0)),
+        # the remaining classes, surfaced so a planner consuming this
+        # dict scales EVERY byte at the peak — a by_class partition
+        # summed from the named keys must equal peak_hbm_bytes
+        "args_bytes": int(cls.get("args", 0)),
+        "constants_bytes": int(cls.get("constants", 0)),
         "compiled": table.get("stats"),
         "top": [{"op": r["op"], "class": r["class"],
                  "bytes": int(r["bytes"]), "opcode": r["opcode"]}

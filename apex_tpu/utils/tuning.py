@@ -26,6 +26,9 @@ winners, and every tunable default consults it at trace time:
     ``ddp_update_allgather_scheme``) via
     ``parallel.weight_update.resolve_mode`` — the measured winner of
     the bench ``update_sharding`` A/B leg
+  - the auto-parallel plan (``plan_*`` keys) via
+    ``parallel.plan.from_tuning`` — the measured winner of the bench
+    ``plan`` A/B leg (the full dp/tp/sp + knob dict)
 
 Precedence everywhere: explicit argument > env override > tuning
 profile > built-in default.  With no profile on disk nothing changes —
@@ -86,6 +89,19 @@ SCHEMA = {
     "ddp_update_sharding": lambda v: v in ("off", "zero1"),
     "ddp_update_allgather_scheme": lambda v: v in ("fp32", "bf16",
                                                    "int8_blockscale"),
+    # auto-parallel planner (parallel.plan): the measured winner of the
+    # bench ``plan`` A/B leg — the full knob dict of the plan that won
+    # on silicon, consumed by ``plan.from_tuning`` on the next run
+    # (only when the ambient chip count matches dp*tp*sp; a winner
+    # measured at one topology says nothing about another)
+    "plan_dp": _is_block,
+    "plan_tp": _is_block,
+    "plan_sp": _is_block,
+    "plan_sp_strategy": lambda v: v in ("none", "ring", "ulysses"),
+    "plan_zero": _is_bool,
+    "plan_update_sharding": lambda v: v in ("off", "zero1"),
+    "plan_collective_scheme": lambda v: v in ("fp32", "bf16",
+                                              "int8_blockscale"),
 }
 
 

@@ -881,6 +881,113 @@ def bench_update_sharding(on_tpu):
     return out
 
 
+def bench_plan(on_tpu, top_k=3, steps=5):
+    """Auto-parallel planner verify leg (ISSUE 10): run the cost-model
+    search over the flagship transformer at the ambient chip count,
+    then MEASURE the top-k predicted plans (plus the all-defaults
+    baseline) through the real DDP training step each plan's
+    ``apply()`` configures.  A one-point calibration on the baseline
+    (scale = measured / predicted) turns the analytic predictions into
+    absolute ms, and the leg reports the calibration error of the
+    first-ranked measurable plan — the number the
+    ``apply_perf_results`` drift guard audits (>25% disagreement means
+    the model can no longer be trusted to pick winners).  The measured
+    winner's knob dict is what ``decide()`` persists as ``plan_*``
+    tuning keys."""
+    import numpy as np
+    from apex_tpu import telemetry
+    from apex_tpu.parallel import plan as planmod
+    from apex_tpu.telemetry import report as treport
+
+    n_dev = len(jax.devices())
+    platform = jax.default_backend()
+    prof, cfg, gb = planmod.flagship_profile()
+    ranked = planmod.search(prof, n_dev, platform=platform)
+    n_all = len(planmod.enumerate_plans(prof, n_dev, platform=platform))
+    _log(f"plan leg: {n_all} candidates, {len(ranked)} feasible at "
+         f"{n_dev} chips")
+
+    baseline = planmod.predict(prof, planmod.default_plan(n_dev),
+                               platform=platform)
+    cand = [p for p in ranked if p.measurable][:top_k]
+    if not any(p.knobs() == baseline.knobs() for p in cand):
+        cand.append(baseline)
+
+    sink = telemetry.MemorySink()
+    reg = telemetry.Registry(sink=sink, flush_interval=0,
+                             rank0_only=False, run_id="bench",
+                             memory=False)
+    h = reg.histogram("step_time_ms")
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (gb, cfg.max_len)).astype("int32"))
+
+    rows = []
+    for p in cand:
+        _log(f"plan leg: measuring [{p.describe() or 'all-defaults'}] ...")
+        with p.apply() as mesh:
+            carry, step = planmod.build_flagship_step(
+                cfg, mesh, global_batch=gb)
+            t0 = time.perf_counter()
+            carry, loss = step(carry, tokens)       # compile + first run
+            _sync(loss)
+            compile_ms = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                carry, loss = step(carry, tokens)
+            _sync(loss)
+            ms = (time.perf_counter() - t0) / steps * 1e3
+        h.observe(ms)
+        rows.append({"knobs": p.knobs(),
+                     "plan": p.describe() or "all-defaults",
+                     "predicted_ms_raw": round(p.predicted_step_ms, 4),
+                     "hbm_bytes": p.predicted_hbm_bytes,
+                     "measured_ms": round(ms, 3),
+                     "compile_ms": round(compile_ms, 1),
+                     "loss": float(loss)})
+        del carry, step
+        gc.collect()
+
+    base_row = next(r for r in rows
+                    if r["knobs"] == baseline.knobs())
+    scale = (base_row["measured_ms"] / base_row["predicted_ms_raw"]
+             if base_row["predicted_ms_raw"] else 1.0)
+    for row in rows:
+        row["predicted_ms"] = round(row["predicted_ms_raw"] * scale, 3)
+
+    # the first measurable candidate IS the plan search would ship —
+    # its calibration error is the leg's headline evidence
+    top = rows[0]
+    err_pct = (abs(top["measured_ms"] - top["predicted_ms"])
+               / top["measured_ms"] * 100.0) if top["measured_ms"] else 0.0
+    win = min(rows, key=lambda r: r["measured_ms"])
+    out = {
+        "leg": "plan", "chips": n_dev, "model": prof.name,
+        "global_batch": gb,
+        "candidates_enumerated": n_all, "feasible": len(ranked),
+        "plans": rows,
+        "predicted_winner": ranked[0].knobs() if ranked else None,
+        "predicted_winner_measurable": bool(ranked and
+                                            ranked[0].measurable),
+        "measured_winner": win["knobs"],
+        "winner_agrees": win["knobs"] == top["knobs"],
+        "baseline_step_ms": base_row["measured_ms"],
+        "calibration_scale": round(scale, 4),
+        "calibration_error_pct": round(err_pct, 2),
+    }
+    reg.gauge("plan.calibration_error_pct").set(err_pct)
+    reg.gauge("plan.baseline_step_ms").set(base_row["measured_ms"])
+    reg.gauge("plan.winner_step_ms").set(win["measured_ms"])
+    _log(f"plan leg: predicted [{top['plan']}] {top['predicted_ms']} ms "
+         f"vs measured {top['measured_ms']} ms "
+         f"(calibration error {out['calibration_error_pct']}%), "
+         f"measured winner [{win['plan']}]")
+    reg.flush()
+    out["telemetry"] = {"records": sink.records,
+                        "summary": treport.summarize(sink.records)}
+    return out
+
+
 def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     """The bench with optional span tracing: ``APEX_BENCH_TRACE=<path>``
     wraps every leg in a span and writes the Chrome-trace timeline on
@@ -1050,6 +1157,18 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
         flush("update_sharding", detail["update_sharding"])
     else:
         _log("skipping update_sharding leg (budget)")
+    gc.collect()
+    # auto-parallel planner verify leg (ISSUE 10): cost-model search +
+    # top-k measured A/B, feeding apply_perf_results' plan_* decision
+    if budget_left() > 60:
+        try:
+            with _leg_span("plan"):
+                detail["plan"] = bench_plan(on_tpu)
+        except Exception as err:
+            detail["plan"] = {"error": repr(err)[:200]}
+        flush("plan", detail["plan"])
+    else:
+        _log("skipping plan leg (budget)")
     gc.collect()
     # max-throughput BERT rung ladder (TPU only — the CPU stand-in says
     # nothing about the remat trade)
@@ -1229,11 +1348,26 @@ def _update_sharding_main():
                       "update_sharding": bench_update_sharding(on_tpu)}))
 
 
+def _plan_main():
+    """``python bench.py --plan``: ONLY the auto-parallel planner A/B
+    on the ambient backend, one JSON line — the cheap leg tpu_watch.sh
+    runs as its own stage 2d (a top-k plan A/B fits a short tunnel
+    window the full bench would waste)."""
+    from apex_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+    on_tpu = jax.default_backend() == "tpu"
+    print(json.dumps({"metric": "plan_ab",
+                      "backend": jax.default_backend(),
+                      "plan": bench_plan(on_tpu)}))
+
+
 if __name__ == "__main__":
     if "--collectives" in sys.argv:
         _collectives_main()
     elif "--update-sharding" in sys.argv:
         _update_sharding_main()
+    elif "--plan" in sys.argv:
+        _plan_main()
     elif "--inner" in sys.argv:
         _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:

@@ -381,6 +381,19 @@ def _stub_timings(bench, monkeypatch, wedge_at=None):
                             "opt_state_shrink": 7.9,
                             "modes": {"off": {"step_ms": 2.0},
                                       "zero1": {"step_ms": 1.5}}}))
+    monkeypatch.setattr(bench, "bench_plan",
+                        mk("bench_plan",
+                           {"leg": "plan", "chips": 8,
+                            "candidates_enumerated": 27,
+                            "calibration_error_pct": 3.0,
+                            "plans": [{"knobs": {"dp": 8},
+                                       "predicted_ms": 1.9,
+                                       "measured_ms": 2.0},
+                                      {"knobs": {"dp": 8,
+                                                 "update_sharding":
+                                                 "zero1"},
+                                       "predicted_ms": 1.6,
+                                       "measured_ms": 1.5}]}))
 
 
 def test_run_bench_flushes_headline_incrementally(tmp_path, monkeypatch):
@@ -415,9 +428,10 @@ def test_run_bench_full_flush_sequence(tmp_path, monkeypatch):
     rn50_key = ("rn50" if jax.default_backend() == "tpu"
                 else "rn50_cpu_standin_resnet18")
     assert set(legs) == {"headline", rn50_key, "bert_e2e", "collectives",
-                         "update_sharding"}
+                         "update_sharding", "plan"}
     assert legs["collectives"]["data"]["leg"] == "collectives"
     assert legs["update_sharding"]["data"]["leg"] == "update_sharding"
+    assert legs["plan"]["data"]["leg"] == "plan"
     assert legs["headline"]["data"]["complete"] is True
     assert legs["headline"]["data"]["winner"] == "fused_flat"
     assert payload["value"] == 19.0

@@ -1,0 +1,479 @@
+"""Auto-parallel planner (ISSUE 10) on the 8-device CPU mesh.
+
+Covers the tentpole and its acceptance gates:
+
+  * cost-model oracles pinned on hand-computable cases (2-chip ring
+    allreduce alpha-beta time; a known-FLOPs matmul's roofline);
+  * the search: >= 12 candidates enumerated for the flagship at 8
+    simulated chips, every HBM-infeasible plan pruned (asserted
+    against ``memory_model()``'s numbers), ties broken toward the
+    simpler plan;
+  * ``Plan.apply()`` reproducing the BITWISE-identical loss/params of
+    the same manually-configured run (mesh + env knobs vs explicit
+    args);
+  * THE verify loop: ``bench.bench_plan`` measures the top predicted
+    plans, the predicted pick lands within 25% of its calibrated
+    prediction and no slower than the all-defaults baseline, and the
+    winning knobs round-trip ``apply_perf_results.decide`` ->
+    schema-valid ``tuned_defaults.json`` -> ``plan.from_tuning`` on
+    the next run;
+  * the ranked-table CLI (``python -m apex_tpu.parallel.plan``) from
+    both a measured artifact and a fresh CPU cost-model run.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel import plan as pm
+from apex_tpu.parallel import collectives
+from apex_tpu.parallel import weight_update as wu
+from apex_tpu.parallel.mesh import create_mesh
+from apex_tpu.utils import tuning
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+N_DEV = 8
+
+#: explicit ceilings for the oracle tests — no env / platform coupling
+CEIL = {"peak_flops": 1e12, "peak_bw": 1e11, "ici_bw": 1e10,
+        "ici_alpha_s": 1e-6, "hbm_bytes": 1e12}
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = {k: os.environ.pop(k, None)
+             for k in (collectives.ENV_KNOB, wu.ENV_KNOB,
+                       "APEX_TPU_CEILINGS")}
+    yield
+    for k, v in saved.items():
+        os.environ.pop(k, None)
+        if v is not None:
+            os.environ[k] = v
+
+
+@pytest.fixture
+def profile_file(tmp_path, monkeypatch):
+    """Point the tuning profile at a temp file (test_tuning idiom)."""
+    path = tmp_path / "tuned.json"
+
+    def write(d):
+        path.write_text(json.dumps(d))
+        tuning.reload()
+
+    monkeypatch.setenv("APEX_TPU_TUNING_FILE", str(path))
+    tuning.reload()
+    yield write
+    monkeypatch.delenv("APEX_TPU_TUNING_FILE")
+    tuning.reload()
+
+
+@pytest.fixture
+def fake_tpu(monkeypatch):
+    jax.devices()                      # ensure backends_initialized()
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+
+@pytest.fixture(scope="module")
+def flagship():
+    """(profile, cfg, global_batch, memory_model dict) for the tiny
+    flagship step — the memory_model() is recomputed independently so
+    the pruning assertions are against ITS numbers, not the profile's
+    copy of them."""
+    from apex_tpu.telemetry import memory as tmem
+    cfg = pm._flagship_cfg(False)
+    step, args = pm._flagship_step(cfg, 8)
+    prof = pm.profile_step(step, *args, name="flagship-test", cfg=cfg,
+                           global_batch=8)
+    mm = tmem.memory_model(step, *args, register=False)
+    return prof, cfg, 8, mm
+
+
+def _synth_profile(**kw):
+    base = dict(name="synth", flops=1e9, bytes_accessed=1e8,
+                params_bytes=4096, optimizer_bytes=12288,
+                activations_bytes=8192, batch_bytes=1024,
+                temps_bytes=512, output_bytes=64, args_bytes=16,
+                constants_bytes=8, peak_hbm_bytes=30000,
+                layers=2, act_layer_bytes=4096, seq=64, heads=4,
+                platform="cpu")
+    base.update(kw)
+    return pm.ModelProfile(**base)
+
+
+# ---------------------------------------------------------------------------
+# cost-model oracles
+# ---------------------------------------------------------------------------
+
+def test_collective_time_oracle_2chip_ring_allreduce():
+    """Hand-computed 2-chip ring allreduce: 2(N-1) hops of alpha +
+    2(N-1)/N of the payload over the link."""
+    logical = 4 * (1 << 20)            # 1M fp32 elems
+    t = pm.collective_time_s("all_reduce", logical, 2, CEIL)
+    assert t == pytest.approx(2 * 1e-6 + 1.0 * logical / 1e10)
+    # reduce-scatter / allgather: half the hops, half the traffic
+    t_rs = pm.collective_time_s("reduce_scatter", logical, 2, CEIL)
+    assert t_rs == pytest.approx(1e-6 + 0.5 * logical / 1e10)
+    assert pm.collective_time_s("all_gather", logical, 2, CEIL) == t_rs
+    # degenerate axes cost nothing
+    assert pm.collective_time_s("all_reduce", logical, 1, CEIL) == 0.0
+    assert pm.collective_time_s("all_reduce", 0, 8, CEIL) == 0.0
+    with pytest.raises(ValueError, match="unknown collective"):
+        pm.collective_time_s("gossip", logical, 2, CEIL)
+
+
+def test_collective_time_scheme_wire_and_codec():
+    """int8_blockscale ships the metered wire bytes (codes + scales)
+    and pays its dequant-sum codec against HBM bandwidth — so it wins
+    on slow wires and loses when the wire is as fast as memory."""
+    logical = 4 * (1 << 20)
+    nelems = logical // 4
+    world = 8
+    wire = collectives.wire_bytes("int8_blockscale", nelems)
+    expected = (2 * (world - 1) * CEIL["ici_alpha_s"]
+                + 2.0 * (world - 1) / world * wire / CEIL["ici_bw"]
+                + (1 + world) * logical / CEIL["peak_bw"])
+    t8 = pm.collective_time_s("all_reduce", logical, world, CEIL,
+                              "int8_blockscale")
+    assert t8 == pytest.approx(expected)
+    t32 = pm.collective_time_s("all_reduce", logical, world, CEIL)
+    assert t8 < t32                    # wire 10x slower than HBM: wins
+    fast_wire = dict(CEIL, ici_bw=CEIL["peak_bw"])
+    assert pm.collective_time_s(
+        "all_reduce", logical, world, fast_wire, "int8_blockscale") > \
+        pm.collective_time_s("all_reduce", logical, world, fast_wire)
+
+
+def test_compute_time_known_flops_matmul():
+    """The parse->model chain on a known workload: a 64x64x64 matmul is
+    exactly 2*M*N*K FLOPs, and the compute-bound roofline time is
+    flops/peak."""
+    a = jnp.ones((64, 64), jnp.float32)
+    prof = pm.profile_step(lambda x, y: x @ y, a, a, name="matmul")
+    assert prof.flops == pytest.approx(2 * 64 ** 3, rel=0.01)
+    t = pm.compute_time_s(prof.flops, 0.0, CEIL)
+    assert t == pytest.approx(prof.flops / CEIL["peak_flops"])
+    # bandwidth-bound when bytes dominate
+    assert pm.compute_time_s(0.0, 1e9, CEIL) == pytest.approx(1e9 / 1e11)
+
+
+def test_profile_step_surfaces_compiled_collectives():
+    """The profile carries the compiled program's real collective
+    payloads (the attrib sub-table) for comm-model calibration."""
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu.parallel.mesh import shard_map
+    mesh = create_mesh({"data": N_DEV})
+    sm = shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                   in_specs=(P("data"),), out_specs=P("data"))
+    prof = pm.profile_step(sm, jnp.ones((N_DEV, 1024)), name="psum")
+    ar = prof.collective_bytes["all-reduce"]
+    assert ar["logical_bytes"] == 1024 * 4
+
+
+# ---------------------------------------------------------------------------
+# HBM model + search
+# ---------------------------------------------------------------------------
+
+def test_hbm_scaling_semantics():
+    """Per-class scaling: tp shards params+optimizer, dp shards the
+    optimizer ONLY when the update is sharded (the
+    ``update_sharding_world`` semantics), activations/temps shard over
+    every axis, batch over dp."""
+    prof = _synth_profile()
+    total, by = pm.plan_hbm_bytes(prof, pm.Plan(dp=8))
+    assert by["params"] == 4096            # replicated over dp
+    assert by["optimizer"] == 12288        # replicated: update is not sharded
+    assert by["activations"] == 8192 // 8
+    assert by["batch"] == 1024 // 8
+    assert total == sum(by.values())
+    _, by_z = pm.plan_hbm_bytes(prof, pm.Plan(dp=8,
+                                              update_sharding="zero1"))
+    assert by_z["optimizer"] == 12288 // 8
+    _, by_tp = pm.plan_hbm_bytes(prof, pm.Plan(dp=4, tp=2))
+    assert by_tp["params"] == 4096 // 2
+    assert by_tp["optimizer"] == 12288 // 2
+    assert by_tp["activations"] == 8192 // 8
+
+
+def test_enumerate_flagship_8chips_ge_12_candidates(flagship):
+    """ACCEPTANCE: the flagship at 8 simulated chips enumerates >= 12
+    candidate plans spanning the axes."""
+    prof, _, _, _ = flagship
+    plans = pm.enumerate_plans(prof, N_DEV, platform="cpu")
+    assert len(plans) >= 12
+    assert all(p.chips == N_DEV for p in plans)
+    assert any(p.tp > 1 for p in plans)                 # dp x tp plane
+    assert any(p.zero for p in plans)                   # ZeRO on/off
+    assert any(p.update_sharding == "zero1" for p in plans)
+    schemes = {p.collective_scheme for p in plans if p.dp > 1}
+    assert schemes == set(pm.PLAN_SCHEMES)
+    # short sequences enumerate no SP plans ...
+    assert all(p.sp == 1 for p in plans)
+    # ... long sequences do (ring always; ulysses when heads divide)
+    long = _synth_profile(seq=4096, heads=8)
+    sp_plans = [p for p in pm.enumerate_plans(long, N_DEV,
+                                              platform="cpu")
+                if p.sp > 1]
+    assert {p.sp_strategy for p in sp_plans} == {"ring", "ulysses"}
+
+
+def test_search_prunes_all_infeasible_against_memory_model(flagship):
+    """Property: ``search`` NEVER returns an HBM-infeasible plan.  The
+    capacity is squeezed until some candidates are infeasible, and
+    feasibility is recomputed here from ``memory_model()``'s own
+    numbers — not trusted from the search."""
+    prof, _, _, mm = flagship
+    # the profile's memory facts ARE memory_model()'s (no drift)
+    assert prof.params_bytes == mm["params_bytes"]
+    assert prof.optimizer_bytes == mm["optimizer_bytes"]
+    assert prof.activations_bytes == mm["activations_bytes"]
+    all_plans = pm.enumerate_plans(prof, N_DEV, platform="cpu")
+    demands = sorted(p.predicted_hbm_bytes for p in all_plans)
+    cap = demands[len(demands) // 2]       # median: some must be pruned
+    ranked = pm.search(prof, N_DEV, platform="cpu", capacity_bytes=cap)
+    assert ranked and len(ranked) < len(all_plans)
+
+    def hbm_from_memory_model(p):
+        opt_div = p.tp * (p.dp if p.shards_update else 1)
+        return (mm["params_bytes"] // p.tp
+                + mm["optimizer_bytes"] // opt_div
+                + mm["activations_bytes"] // (p.dp * p.tp * p.sp)
+                + mm["batch_bytes"] // (p.dp * p.sp)
+                + mm["temps_bytes"] // (p.dp * p.tp * p.sp)
+                + mm["output_bytes"] // p.dp
+                + mm["args_bytes"] + mm["constants_bytes"])
+
+    for p in ranked:
+        assert hbm_from_memory_model(p) <= cap, p.describe()
+    assert any(hbm_from_memory_model(p) > cap for p in all_plans)
+
+
+def test_tie_break_prefers_simpler_plan():
+    """Predictions inside the tie band resolve to the SIMPLEST plan:
+    with negligible params (no wire, no update to shrink) every dp=8
+    variant predicts the same, and the all-defaults baseline must rank
+    first."""
+    prof = _synth_profile(params_bytes=512, optimizer_bytes=1536,
+                          layers=0)
+    ranked = pm.search(prof, N_DEV, ceilings=CEIL)
+    assert ranked[0].knobs() == pm.default_plan(N_DEV).knobs()
+
+
+def test_int8_wins_on_tpu_wire_loses_on_cpu(flagship):
+    """The codec model makes compression platform-aware: on TPU
+    ceilings (ICI far slower than HBM) the int8 dp wire beats fp32; on
+    the CPU-emulated mesh (wire ~ memory) it loses."""
+    prof, _, _, _ = flagship
+
+    def dp_comm(platform, scheme):
+        p = pm.predict(prof, pm.Plan(dp=N_DEV,
+                                     collective_scheme=scheme),
+                       platform=platform)
+        return p.breakdown["dp_comm_ms"]
+
+    assert dp_comm("tpu", "int8_blockscale") < dp_comm("tpu", "fp32")
+    assert dp_comm("cpu", "int8_blockscale") > dp_comm("cpu", "fp32")
+
+
+# ---------------------------------------------------------------------------
+# Plan.apply: env round-trip + the bitwise A/B
+# ---------------------------------------------------------------------------
+
+def test_apply_env_roundtrip(monkeypatch):
+    """apply() engages exactly the plan's env knobs inside the context,
+    masks conflicting ambient knobs, and restores everything after."""
+    monkeypatch.setenv(collectives.ENV_KNOB, "bf16")   # ambient A/B var
+    plan = pm.Plan(dp=N_DEV, update_sharding="zero1")
+    with plan.apply() as mesh:
+        assert dict(mesh.shape)["data"] == N_DEV
+        assert os.environ.get(wu.ENV_KNOB) == "zero1"
+        # the plan's fp32 wire means NO collectives knob — the ambient
+        # one must not leak into the applied plan
+        assert collectives.ENV_KNOB not in os.environ
+    assert os.environ.get(collectives.ENV_KNOB) == "bf16"   # restored
+    assert wu.ENV_KNOB not in os.environ
+    plan8 = pm.Plan(dp=N_DEV, collective_scheme="int8_blockscale")
+    with plan8.apply():
+        assert os.environ[collectives.ENV_KNOB] == "int8_blockscale"
+    assert os.environ.get(collectives.ENV_KNOB) == "bf16"
+
+
+def _ab_cfg():
+    return pm._flagship_cfg(False, num_layers=1, d_model=32, d_ff=64,
+                            vocab_size=64, max_len=16, num_heads=2)
+
+
+def _ab_batch(i):
+    rng = np.random.RandomState(1000 + i)
+    return jnp.asarray(rng.randint(0, 64, (N_DEV, 16)).astype("int32"))
+
+
+@pytest.mark.parametrize("ddp_kwargs", [
+    {}, {"update_sharding": "zero1"},
+], ids=["all-defaults", "zero1"])
+def test_apply_reproduces_manual_run_bitwise(ddp_kwargs):
+    """ACCEPTANCE: training under ``plan.apply()`` (mesh + env knobs,
+    knob-less DDP inside) is BITWISE the same run configured by hand
+    (explicit mesh + explicit DDP args) — losses and params."""
+    cfg = _ab_cfg()
+
+    def run_manual():
+        mesh = create_mesh({"data": N_DEV})
+        carry, step = pm.build_flagship_step(cfg, mesh, global_batch=8,
+                                             ddp_kwargs=ddp_kwargs)
+        losses = []
+        for i in range(3):
+            carry, loss = step(carry, _ab_batch(i))
+            losses.append(float(loss))
+        return carry, losses
+
+    def run_plan():
+        plan = pm.Plan(dp=N_DEV,
+                       update_sharding=ddp_kwargs.get("update_sharding",
+                                                      "off"))
+        with plan.apply() as mesh:
+            carry, step = pm.build_flagship_step(cfg, mesh,
+                                                 global_batch=8)
+            losses = []
+            for i in range(3):
+                carry, loss = step(carry, _ab_batch(i))
+                losses.append(float(loss))
+        return carry, losses
+
+    (pm_, _), lm = run_manual()
+    (pp_, _), lp = run_plan()
+    assert lm == lp
+    assert lm[-1] < lm[0]              # training actually happened
+    for (kp_a, a), (kp_b, b) in zip(
+            jax.tree_util.tree_leaves_with_path(pm_),
+            jax.tree_util.tree_leaves_with_path(pp_)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(kp_a))
+
+
+# ---------------------------------------------------------------------------
+# the verify/persist loop (bench.py --plan -> apply_perf_results ->
+# tuned_defaults.json -> from_tuning)
+# ---------------------------------------------------------------------------
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_plan", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_apply():
+    spec = importlib.util.spec_from_file_location(
+        "apply_perf_for_plan",
+        os.path.join(ROOT, "tools", "apply_perf_results.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_plan_acceptance_loop(profile_file, monkeypatch):
+    """ACCEPTANCE: ``bench_plan`` on the CPU mesh — >= 12 candidates,
+    the predicted-fastest plan's measured step time within 25% of its
+    calibrated prediction and no slower than the all-defaults
+    baseline, the artifact passes the drift-guard audit, and the
+    winning knobs round-trip decide -> schema-valid tuned_defaults ->
+    ``from_tuning`` on the 'next run'."""
+    bench = _load_bench()
+    out = bench.bench_plan(False, top_k=2, steps=2)
+    assert out["candidates_enumerated"] >= 12
+    assert out["feasible"] >= 1
+    rows = out["plans"]
+    assert len(rows) >= 2
+    # rows[0] is the ranked pick (the leg's contract): within 25% of
+    # its calibrated prediction, and no slower than the baseline
+    top = rows[0]
+    assert out["calibration_error_pct"] <= 25.0, out
+    assert top["measured_ms"] <= out["baseline_step_ms"] * 1.0001, out
+    # audit: no drift, telemetry well-formed
+    mod = _load_apply()
+    artifact = {"backend": "tpu", "detail": {"plan": out}}
+    assert mod.plan_violations(artifact) == []
+    from apex_tpu.telemetry import records_violations
+    assert records_violations(out["telemetry"]["records"]) == []
+
+    # persist: decide -> schema-valid profile -> consumed next run
+    prof_keys, rows_tbl = mod.decide(artifact, None)
+    plan_keys = {k: v for k, v in prof_keys.items()
+                 if k.startswith("plan_")}
+    assert plan_keys, rows_tbl
+    assert tuning.schema_violations(prof_keys) == []
+    profile_file(prof_keys)
+    jax.devices()
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    tuned = pm.from_tuning(N_DEV)
+    assert tuned is not None
+    win = out["measured_winner"]
+    assert tuned.dp == win["dp"]
+    assert tuned.update_sharding == win["update_sharding"]
+    assert tuned.collective_scheme == win["collective_scheme"]
+    # a winner measured at another topology never applies
+    assert pm.from_tuning(N_DEV * 2) is None
+
+
+def test_from_tuning_posture(profile_file, fake_tpu):
+    profile_file({"plan_dp": 8, "plan_update_sharding": "zero1"})
+    p = pm.from_tuning(8)
+    assert p is not None and p.update_sharding == "zero1"
+    assert p.tp == 1 and p.collective_scheme == "fp32"   # defaults
+    assert pm.from_tuning(4) is None                     # chips mismatch
+    profile_file({})
+    assert pm.from_tuning(8) is None                     # no plan keys
+
+
+def test_from_tuning_ignored_off_tpu(profile_file):
+    """Measured winners apply where they were measured — the CPU
+    backend must not consume a TPU-measured plan (tooling can opt in
+    with tpu_only=False)."""
+    profile_file({"plan_dp": 8})
+    assert pm.from_tuning(8) is None
+    assert pm.from_tuning(8, tpu_only=False) is not None
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_renders_artifact_and_fresh_run(tmp_path):
+    """``python -m apex_tpu.parallel.plan`` renders the ranked table
+    from a measured artifact AND from a fresh CPU cost-model run."""
+    art = {"metric": "plan_ab", "backend": "cpu", "plan": {
+        "leg": "plan", "chips": 8, "plans": [
+            {"knobs": {"dp": 8, "update_sharding": "zero1"},
+             "predicted_ms": 1.5, "measured_ms": 1.4,
+             "hbm_bytes": 1 << 20},
+            {"knobs": {"dp": 8}, "predicted_ms": 2.0,
+             "measured_ms": 2.0, "hbm_bytes": 1 << 20}]}}
+    path = tmp_path / "plan_ab.json"
+    path.write_text(json.dumps(art))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT}
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.plan",
+         "--artifact", str(path)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "winner knobs" in r.stdout
+    assert "us=zero1" in r.stdout
+    assert "1.400" in r.stdout                 # measured column rendered
+
+    r2 = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.plan",
+         "--chips", "8", "--model", "flagship",
+         "--layers", "1", "--seq", "16", "--batch", "8"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r2.returncode == 0, r2.stderr
+    assert "HBM-feasible" in r2.stdout
+    assert "winner knobs" in r2.stdout

@@ -178,3 +178,34 @@ def test_parse_real_capture(tmp_path):
     # the matmul must show up on an XLA/runtime thread
     assert any("dot" in r["name"] for r in table), \
         [r["name"] for r in table[:10]]
+
+
+def test_resolve_ceilings_generations_and_env(monkeypatch):
+    """ISSUE 10 satellite: per-TPU-generation ceilings rows plus the
+    documented APEX_TPU_CEILINGS override, so planner/roofline
+    predictions aren't pinned to the single generic "tpu" row."""
+    monkeypatch.delenv(prof.ENV_CEILINGS, raising=False)
+    # every row carries the full key set (the planner reads all of them)
+    for name, row in prof.HW_CEILINGS.items():
+        assert set(row) == set(prof.CEILING_KEYS), name
+    # the generic tpu row stays the v5e chip the r5 runs measured on
+    assert prof.HW_CEILINGS["tpu"] == prof.HW_CEILINGS["tpu_v5e"]
+    assert prof.resolve_ceilings("tpu") == prof.HW_CEILINGS["tpu"]
+    # unknown platform falls back to the cpu row (attrib posture)
+    assert prof.resolve_ceilings("quantum") == prof.HW_CEILINGS["cpu"]
+    # named-row override (shorthand resolves to the tpu_* row)
+    monkeypatch.setenv(prof.ENV_CEILINGS, "v5p")
+    assert prof.resolve_ceilings("tpu")["peak_flops"] == \
+        prof.HW_CEILINGS["tpu_v5p"]["peak_flops"]
+    # row + key override, applied left to right
+    monkeypatch.setenv(prof.ENV_CEILINGS, "v4,ici_bw=5e10")
+    c = prof.resolve_ceilings("tpu")
+    assert c["peak_bw"] == prof.HW_CEILINGS["tpu_v4"]["peak_bw"]
+    assert c["ici_bw"] == 5e10
+    # a typo'd key or row fails loudly, never silently
+    monkeypatch.setenv(prof.ENV_CEILINGS, "peak_floops=1e12")
+    with pytest.raises(ValueError, match="unknown ceiling"):
+        prof.resolve_ceilings("tpu")
+    monkeypatch.setenv(prof.ENV_CEILINGS, "v9000")
+    with pytest.raises(ValueError, match="unknown ceilings row"):
+        prof.resolve_ceilings("tpu")

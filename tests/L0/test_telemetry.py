@@ -575,6 +575,41 @@ def test_attrib_rows_sorted_and_shared_ceilings():
     assert table["peak_flops"] == ceil["peak_flops"]
     for r in table["rows"]:
         assert r["projected_us"] >= 0.0
+    # no collectives in a single-device program -> empty sub-table
+    assert table["collectives"]["rows"] == []
+    assert table["collectives"]["total_logical_bytes"] == 0
+
+
+def test_attrib_collectives_subtable_logical_bytes():
+    """ISSUE 10 satellite: ``op_table`` surfaces per-collective logical
+    bytes in a ``collectives`` sub-table, so the planner's comm model
+    can be calibrated against what the compiled program actually
+    exchanges.  Under shard_map the shapes are per-partition — the
+    per-device payload the alpha-beta model predicts."""
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu.parallel.mesh import create_mesh, shard_map
+    from apex_tpu.telemetry import attrib
+
+    n_dev = len(jax.devices())
+    mesh = create_mesh({"data": n_dev})
+    elems = 2048
+
+    def f(x):
+        return jax.lax.psum(x, "data")
+
+    sm = shard_map(f, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P("data"))
+    table = attrib.op_table(sm, jnp.ones((n_dev, elems)))
+    coll = table["collectives"]
+    ar = coll["by_opcode"]["all-reduce"]
+    # an all-reduce's logical payload is the per-device buffer, both
+    # in and out
+    assert ar["logical_bytes"] == elems * 4
+    assert ar["in_bytes"] == elems * 4
+    assert ar["out_bytes"] == elems * 4
+    assert coll["total_logical_bytes"] >= elems * 4
+    # the sub-table renders in the formatted output
+    assert "per-collective logical bytes" in attrib.format_op_table(table)
 
 
 # ---------------------------------------------------------------------------

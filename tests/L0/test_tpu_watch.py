@@ -45,6 +45,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_COLL_CMD": "",
            # same for the weight-update-sharding A/B (stage 2c)
            "APEX_WATCH_US_CMD": "",
+           # and the auto-parallel plan A/B (stage 2d)
+           "APEX_WATCH_PLAN_CMD": "",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
            **env_extra}
@@ -455,6 +457,51 @@ def test_update_sharding_ab_stage_artifact_and_span(tmp_path):
     assert "update_sharding A/B done rc=1" in log3
     assert not (tmp_path / "US_FAIL.json").exists()
     assert not (tmp_path / "US_FAIL.json.run").exists()
+
+
+def test_plan_ab_stage_artifact_and_span(tmp_path):
+    """ISSUE 10 satellite: the auto-parallel plan A/B runs as watch
+    stage 2d — artifact written atomically, span appended to the
+    streaming timeline, skip-when-complete, and a failing leg leaves no
+    truncated artifact behind (mirror of stages 2b/2c)."""
+    fake = json.dumps({"metric": "plan_ab", "backend": "tpu",
+                       "plan": {"leg": "plan", "plans": []}})
+    marker = tmp_path / "plan_calls"
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    }
+    r, log = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_PLAN_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    art = json.loads((tmp_path / "PLAN_AB_r5.json").read_text())
+    assert art["plan"]["leg"] == "plan"
+    assert "plan A/B done rc=0" in log
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.plan_ab" in names
+    # second window: artifact present -> stage skipped
+    r2, _ = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_PLAN_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+
+    # a failing A/B leaves no truncated artifact behind
+    r3, log3 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_PLAN_JSON": "PLAN_FAIL.json",
+        "APEX_WATCH_PLAN_CMD": "echo '{\"partial\":true'; false",
+    })
+    assert r3.returncode == 0
+    assert "plan A/B done rc=1" in log3
+    assert not (tmp_path / "PLAN_FAIL.json").exists()
+    assert not (tmp_path / "PLAN_FAIL.json.run").exists()
 
 
 def test_stage_spans_record_failures_too(tmp_path):

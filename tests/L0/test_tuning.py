@@ -334,6 +334,58 @@ def test_decide_collective_scheme_from_ab_leg():
     assert any("ratio" in v for v in mod.collective_violations(bench))
 
 
+def _plan_leg(err=3.0):
+    return {
+        "leg": "plan", "chips": 8, "candidates_enumerated": 27,
+        "feasible": 27, "baseline_step_ms": 2.0,
+        "calibration_error_pct": err,
+        "telemetry": {"records": [], "summary": {}},
+        "plans": [
+            {"knobs": {"dp": 8, "tp": 1, "sp": 1,
+                       "sp_strategy": "none", "zero": False,
+                       "update_sharding": "zero1",
+                       "collective_scheme": "fp32",
+                       "allgather_scheme": "fp32"},
+             "plan": "dp=8 us=zero1",
+             "predicted_ms": 1.55, "measured_ms": 1.5},
+            {"knobs": {"dp": 8, "tp": 1, "sp": 1,
+                       "sp_strategy": "none", "zero": False,
+                       "update_sharding": "off",
+                       "collective_scheme": "fp32",
+                       "allgather_scheme": "fp32"},
+             "plan": "all-defaults",
+             "predicted_ms": 2.0, "measured_ms": 2.0}]}
+
+
+def test_decide_plan_from_ab_leg():
+    """The bench ``plan`` A/B leg decides the plan_* keys: the MEASURED
+    winner's knob dict is persisted (schema-valid), but only while the
+    calibration drift guard holds."""
+    mod = _load_apply()
+    bench, kern = _tpu_artifacts()
+    bench["detail"]["plan"] = _plan_leg()
+    prof, rows = mod.decide(bench, kern)
+    assert prof["plan_dp"] == 8 and prof["plan_tp"] == 1
+    assert prof["plan_update_sharding"] == "zero1"
+    assert prof["plan_collective_scheme"] == "fp32"
+    assert prof["plan_zero"] is False
+    assert tuning.schema_violations(dict(prof)) == []
+    assert any("plan" in r[0] for r in rows)
+    assert mod.plan_violations(bench) == []
+    # a drifted model (>25% calibration error) must not persist a plan
+    bench["detail"]["plan"] = _plan_leg(err=40.0)
+    prof2, _ = mod.decide(bench, kern)
+    assert not any(k.startswith("plan_") for k in prof2)
+    assert any("calibration error" in v
+               for v in mod.plan_violations(bench))
+    # a predicted pick measuring >25% behind the measured winner is
+    # drift too (the ranked pick is row 0 by the leg's contract)
+    leg = _plan_leg()
+    leg["plans"][0]["measured_ms"] = 2.8
+    assert any("calibration drift" in v
+               for v in mod.plan_violations({"plan": leg}))
+
+
 def test_decide_skips_cpu_tagged_kernels():
     mod = _load_apply()
     bench, kern = _tpu_artifacts()

@@ -58,6 +58,15 @@ and TPU-backed; absent keys leave the built-in defaults untouched):
                            elect zero1 for a config that won't be
                            consumed), and when it wins it also pins
                            ddp_update_allgather_scheme
+  plan_*                <- the bench ``plan`` A/B leg (auto-parallel
+                           planner, parallel.plan): the MEASURED
+                           winner's full knob dict (dp/tp/sp + zero /
+                           update_sharding / collective scheme),
+                           persisted only when the calibration drift
+                           guard holds (model error <= 25% and the
+                           predicted pick within 25% of the measured
+                           winner) and the winner is no slower than
+                           the all-defaults baseline
 
 The headline flat-engine winner and vs_baseline are recorded in the
 table (informational — the optimizer ``impl`` is a user-facing state
@@ -286,6 +295,57 @@ def update_sharding_violations(artifact) -> list:
                             and ratio >= 3.5):
                         out.append(f"{path}: {mode} allgather ratio "
                                    f"{ratio!r} < 3.5")
+        for k, v in node.items():
+            if k != "telemetry":
+                walk(v, f"{path}.{k}")
+
+    walk(artifact if isinstance(artifact, dict) else {}, "artifact")
+    return out
+
+
+def plan_violations(artifact) -> list:
+    """Audit for the bench ``plan`` A/B leg (ISSUE 10): the leg must
+    carry measured rows (>= 2, including the all-defaults baseline)
+    with predictions attached, and the CALIBRATION DRIFT GUARD must
+    hold — the measured winner's step time within 25% of the plan the
+    model ranked first (its first measurable candidate), and the
+    model's own calibration error under 25%.  A drifted artifact means
+    the cost model no longer describes this machine; its persisted
+    ``plan_*`` winners can't be trusted.  Warnings only, same posture
+    as the other audits."""
+    out = []
+
+    def walk(node, path):
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+            return
+        if not isinstance(node, dict):
+            return
+        if node.get("leg") == "plan" and "plans" in node:
+            rows = [r for r in (node.get("plans") or [])
+                    if isinstance(r, dict)
+                    and isinstance(r.get("measured_ms"), (int, float))]
+            if len(rows) < 2:
+                out.append(f"{path}: plan leg measured {len(rows)} "
+                           "plans (need the ranked pick AND the "
+                           "baseline)")
+            else:
+                top_ms = rows[0]["measured_ms"]
+                best_ms = min(r["measured_ms"] for r in rows)
+                if best_ms and top_ms > 1.25 * best_ms:
+                    out.append(
+                        f"{path}: calibration drift — predicted pick "
+                        f"measured {top_ms} ms vs measured winner "
+                        f"{best_ms} ms (>25% apart)")
+            err = node.get("calibration_error_pct")
+            if not isinstance(err, (int, float)):
+                out.append(f"{path}: plan leg carries no "
+                           "calibration_error_pct")
+            elif err > 25.0:
+                out.append(f"{path}: calibration error {err}% > 25%")
+            if not isinstance(node.get("telemetry"), dict):
+                out.append(f"{path}: plan leg embeds no telemetry")
         for k, v in node.items():
             if k != "telemetry":
                 walk(v, f"{path}.{k}")
@@ -560,6 +620,45 @@ def decide(bench, kern):
                         f"winning variant's metered allgather "
                         f"ratio {zrows[best_z]['ag_ratio']}x"))
 
+        pl = det.get("plan")
+        if isinstance(pl, dict) and pl.get("_backend") in (None, "tpu") \
+                and isinstance(pl.get("plans"), list):
+            # plan_* <- the bench ``plan`` leg's MEASURED winner (the
+            # model only nominates candidates; measurement elects).
+            # Only persisted when the drift guard holds — a winner
+            # picked while the cost model was >25% wrong about this
+            # machine is evidence of drift, not of a winner — and only
+            # when the winner is no slower than the all-defaults
+            # baseline (otherwise the defaults ARE the winner).
+            mrows = [r for r in pl["plans"] if isinstance(r, dict)
+                     and isinstance(r.get("measured_ms"), (int, float))
+                     and isinstance(r.get("knobs"), dict)]
+            base_ms = pl.get("baseline_step_ms")
+            err = pl.get("calibration_error_pct")
+            if mrows and isinstance(base_ms, (int, float)) \
+                    and isinstance(err, (int, float)) and err <= 25.0 \
+                    and not plan_violations({"plan": pl}):
+                win = min(mrows, key=lambda r: r["measured_ms"])
+                kn = win["knobs"]
+                if win["measured_ms"] <= base_ms:
+                    prof["plan_dp"] = int(kn.get("dp", 1))
+                    prof["plan_tp"] = int(kn.get("tp", 1))
+                    prof["plan_sp"] = int(kn.get("sp", 1))
+                    prof["plan_sp_strategy"] = kn.get("sp_strategy",
+                                                      "none")
+                    prof["plan_zero"] = bool(kn.get("zero", False))
+                    prof["plan_update_sharding"] = kn.get(
+                        "update_sharding", "off")
+                    prof["plan_collective_scheme"] = kn.get(
+                        "collective_scheme", "fp32")
+                    rows.append((
+                        "plan_* (auto-parallel)",
+                        win.get("plan", "winner"),
+                        f"measured {win['measured_ms']} ms vs baseline "
+                        f"{base_ms} ms over {len(mrows)} measured of "
+                        f"{pl.get('feasible')} feasible plans; "
+                        f"calibration error {err}%"))
+
     return prof, rows
 
 
@@ -606,6 +705,10 @@ def main(argv=None):
             # so does the update_sharding A/B leg (reduce-scatter /
             # param-allgather counters + the ~1/N state shrink)
             for v in update_sharding_violations(art):
+                print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
+            # and the plan A/B leg (measured rows + the >25%
+            # calibration drift guard)
+            for v in plan_violations(art):
                 print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
 
     prof, rows = decide(bench, kern)

@@ -100,6 +100,14 @@ COLL_TO=${APEX_WATCH_COLL_TO:-300}
 US_CMD=${APEX_WATCH_US_CMD-"python bench.py --update-sharding"}
 US_JSON=${APEX_WATCH_US_JSON:-UPDATE_SHARDING_AB_r5.json}
 US_TO=${APEX_WATCH_US_TO:-300}
+# stage 2d: auto-parallel plan A/B (ISSUE 10) — cost-model search over
+# dp/tp/ZeRO/update-sharding/schemes, then the top-3 predicted plans
+# measured through the real DDP step; the artifact feeds
+# apply_perf_results' plan_* decision and its >25% calibration drift
+# guard.  ${VAR-default}: an explicitly EMPTY override disables it
+PLAN_CMD=${APEX_WATCH_PLAN_CMD-"python bench.py --plan"}
+PLAN_JSON=${APEX_WATCH_PLAN_JSON:-PLAN_AB_r5.json}
+PLAN_TO=${APEX_WATCH_PLAN_TO:-400}
 INTEROP_CMD=${APEX_WATCH_INTEROP_CMD:-"python tools/bench_interop.py"}
 INTEROP_JSON=${APEX_WATCH_INTEROP_JSON:-INTEROP_r5.json}
 INTEROP_TO=${APEX_WATCH_INTEROP_TO:-600}
@@ -263,6 +271,21 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$US_JSON".run
       fi
       echo "$(date +%H:%M:%S) update_sharding A/B done rc=$rcu" >> "$LOG"
+    fi
+    # ---- stage 2d: auto-parallel plan A/B (best-effort, short) ----
+    if [ -n "$PLAN_CMD" ] && [ ! -s "$PLAN_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$PLAN_TO" bash -c "$PLAN_CMD" > "$PLAN_JSON".run 2>> "$LOG"
+      rcp=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span plan_ab "$t0" "$rcp"
+      stage_mem
+      if [ $rcp -eq 0 ] && [ -s "$PLAN_JSON".run ]; then
+        mv "$PLAN_JSON".run "$PLAN_JSON"
+      else
+        # a wedged/failed A/B never leaves a truncated artifact behind
+        rm -f "$PLAN_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) plan A/B done rc=$rcp" >> "$LOG"
     fi
     # ---- stage 3a: guard-driven resumable train (incremental) ----
     # BEFORE the all-or-nothing save/resume leg: the guard leg makes
