@@ -26,6 +26,7 @@ from . import contrib
 from . import pyprof
 from . import telemetry
 from . import resilience
+from . import elastic
 from . import interop
 from . import RNN
 from . import reparameterization

@@ -496,6 +496,46 @@ def allgather_flat(x, axis_name, spec: Optional[CollectiveSpec] = None,
             x.size * jnp.dtype(x.dtype).itemsize, str(x.dtype))
 
 
+def rechunk_flat(buf, *, used: int, total: int):
+    """Deterministically re-slice a canonical flat buffer to a new
+    chunk-padded length — the elastic-resume primitive
+    (``apex_tpu.elastic``).
+
+    The zero1/ZeRO flat layouts are *canonical*: the per-leaf content of
+    the buffer depends only on the pytree (LANE-aligned leaf offsets,
+    ``flattener.offsets``), never on the world size — only the trailing
+    padding that rounds ``used`` up to a whole number of per-shard
+    chunks does.  So moving a checkpointed flat field (master/moment
+    buffers, int8 error-feedback residuals) from an N-way to an M-way
+    layout is exactly: keep the first ``used`` elements, re-pad with
+    zeros to the new ``total``.  Padding is provably zero in every flat
+    field this serves: ``TreeFlattener.flatten`` zero-pads, the fused
+    optimizers propagate zero grads/params to zero state there, and an
+    all-zero block quantizes with scale 0 so the EF residual is zero
+    too — which is also why the re-slice preserves the residual *sum*
+    bitwise.  A nonzero tail is real data this re-slice would destroy,
+    so it raises instead of truncating.
+
+    Host-side (numpy) on checkpoint payloads — never traced.
+    """
+    import numpy as np
+    a = np.asarray(buf).reshape(-1)
+    used, total = int(used), int(total)
+    if used > a.shape[0] or used > total:
+        raise ValueError(
+            f"rechunk_flat: used={used} exceeds the buffer ({a.shape[0]}) "
+            f"or the target total ({total})")
+    tail = a[used:]
+    if tail.size and np.any(tail != 0):
+        raise ValueError(
+            f"rechunk_flat: buffer carries nonzero data beyond its used "
+            f"length ({used} of {a.shape[0]}) — not a canonical flat "
+            "buffer; refusing to truncate real data")
+    out = np.zeros((total,), a.dtype)
+    out[:used] = a[:used]
+    return out
+
+
 def reduce(spec: CollectiveSpec, x, axis_name, *, residual=None):
     """Reduce one fp32 leaf over ``axis_name`` under ``spec``'s scheme
     (no per-bucket thresholding here — callers route via

@@ -77,6 +77,7 @@ __all__ = [
     "ModelProfile", "Plan", "profile_step", "flagship_profile",
     "collective_time_s", "compute_time_s", "predict", "plan_hbm_bytes",
     "enumerate_plans", "search", "default_plan", "from_tuning",
+    "set_replan_hook", "get_replan_hook",
     "build_flagship_step", "format_plans", "PLAN_SCHEMES", "TUNING_KEYS",
 ]
 
@@ -742,15 +743,39 @@ TUNING_KEYS = ("plan_dp", "plan_tp", "plan_sp", "plan_sp_strategy",
                "plan_zero", "plan_update_sharding",
                "plan_collective_scheme")
 
+#: elastic re-plan hook: ``hook(tuned_plan, chips) -> Optional[Plan]``.
+#: ``apex_tpu.elastic.install()`` registers one so a tuned plan whose
+#: chip count no longer matches the fleet triggers a fresh
+#: :func:`search` at the NEW chip count (AMP's re-run-the-search-when-
+#: the-pool-changes posture) instead of silently falling back to
+#: all-defaults.  Without a hook the legacy behavior stands: a winner
+#: measured at one topology says nothing about another -> None.
+_REPLAN_HOOK = None
+
+
+def set_replan_hook(hook):
+    """Install the chips-mismatch re-plan hook (None uninstalls).
+    Returns the previous hook so callers can restore it."""
+    global _REPLAN_HOOK
+    prev = _REPLAN_HOOK
+    _REPLAN_HOOK = hook
+    return prev
+
+
+def get_replan_hook():
+    return _REPLAN_HOOK
+
 
 def from_tuning(chips: Optional[int] = None, *,
                 tpu_only: bool = True) -> Optional[Plan]:
     """The persisted measured-winner plan from ``tuned_defaults.json``
     (``plan_*`` keys), or None when absent.  ``chips`` given: a plan
-    tuned for a different topology returns None — a winner measured at
-    one chip count says nothing about another.  ``tpu_only`` follows
-    the tuning posture (measured winners apply where they were
-    measured); pass False for rendering/tooling."""
+    tuned for a different topology is a *re-plan trigger* when an
+    elastic hook is installed (:func:`set_replan_hook` — the hook
+    re-runs the cost-model search for the live chip count), else None —
+    a winner measured at one chip count says nothing about another.
+    ``tpu_only`` follows the tuning posture (measured winners apply
+    where they were measured); pass False for rendering/tooling."""
     from ..utils import tuning
     get = tuning.get_on_tpu if tpu_only else tuning.get
     dp = get("plan_dp")
@@ -764,6 +789,8 @@ def from_tuning(chips: Optional[int] = None, *,
         collective_scheme=get("plan_collective_scheme", "fp32"),
     )
     if chips is not None and plan.chips != int(chips):
+        if _REPLAN_HOOK is not None:
+            return _REPLAN_HOOK(plan, int(chips))
         return None
     return plan
 

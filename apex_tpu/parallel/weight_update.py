@@ -217,6 +217,29 @@ class ShardedUpdate:
         # number of 128-lanes (the ZeRO alignment, distributed_fused.py)
         return self.optimizer.flattener_for(params, chunk=LANE * n_shards)
 
+    def layout_meta(self, params, n_shards: int) -> dict:
+        """The flat-shard layout facts a checkpoint manifest records so
+        an elastic resume (``apex_tpu.elastic``) can re-slice the
+        N-way state into M-way shards deterministically: the chunk pin
+        (``LANE * n_shards``), the padded canonical total, the ``used``
+        prefix that carries real leaf data (``flattener.offsets[-1]`` —
+        everything past it is zero padding, the fact
+        ``collectives.rechunk_flat`` relies on), and each shard's
+        offset into the canonical buffer.  Checkpointed flat fields
+        (master/moments, EF residuals) are *canonical-flat exports
+        already*: ``jax.device_get`` of the P("data")-sharded global
+        array gathers the shards back into this exact layout."""
+        fl = self._fl(params, n_shards)
+        per = fl.total // n_shards
+        return {
+            "kind": "zero1_flat",
+            "lane": LANE,
+            "chunk": fl.chunk,
+            "flat_total": fl.total,
+            "used": int(fl.offsets[-1]),
+            "shard_offsets": [i * per for i in range(n_shards)],
+        }
+
     # -- scheme resolution (trace time) --------------------------------------
 
     def _resolve_rs(self):

@@ -35,18 +35,64 @@ from ..checkpoint import CheckpointError
 
 MANIFEST = "MANIFEST.json"
 
+#: manifest meta keys an elastic reshard needs (``layout`` is the
+#: ``ShardedUpdate.layout_meta`` dict: chunk pin, flat total, used
+#: prefix, shard offsets)
+META_LAYOUT_KEY = "layout"
+META_WORLD_KEY = "world_size"
+META_PLAN_KEY = "plan"
+
+
+class WorldSizeMismatchError(CheckpointError):
+    """A checkpoint written at one world size is being resumed at
+    another without ``apex_tpu.elastic`` installed to reshard it.
+    Carries both counts so the operator sees exactly what changed."""
+
+    def __init__(self, saved_world: int, live_world: int,
+                 detail: str = ""):
+        self.saved_world = int(saved_world)
+        self.live_world = int(live_world)
+        msg = (f"checkpoint was written at world size {saved_world} but "
+               f"this run has world size {live_world}; resuming across "
+               "a chip-count change needs apex_tpu.elastic (install it "
+               "with apex_tpu.elastic.install(), or pass elastic= to "
+               "TrainGuard) — a blind restore would produce garbage "
+               "optimizer shards, not a training run")
+        if detail:
+            msg += f" [{detail}]"
+        super().__init__(msg)
+
+
+class ManifestCompatWarning(UserWarning):
+    """The manifest predates the elastic metadata (older PR): no world
+    size / flat-shard layout recorded, so resharding is unavailable and
+    only a same-world resume is possible."""
+
 
 class CheckpointManager:
-    """Rotating, manifest-tracked checkpoints in one directory."""
+    """Rotating, manifest-tracked checkpoints in one directory.
+
+    ``meta`` (or :meth:`set_meta`) attaches run-level facts to the
+    manifest — the live world size, the active plan knobs, and the
+    flat-shard layout — which :mod:`apex_tpu.elastic` reads at resume
+    to decide whether (and how) to reshard across a chip-count change.
+    A manifest written before these fields existed simply reads back an
+    empty meta (:meth:`manifest_meta`) — degrade, never KeyError."""
 
     def __init__(self, directory: str, *, keep_last: int = 3,
-                 prefix: str = "ckpt"):
+                 prefix: str = "ckpt", meta: Optional[Dict[str, Any]] = None):
         if keep_last < 1:
             raise ValueError(f"keep_last must be >= 1, got {keep_last}")
         self.directory = os.path.abspath(directory)
         self.keep_last = int(keep_last)
         self.prefix = prefix
+        self.meta: Dict[str, Any] = dict(meta or {})
         self._lock = threading.Lock()
+
+    def set_meta(self, meta: Optional[Dict[str, Any]]) -> None:
+        """Replace the manifest meta written by subsequent saves."""
+        with self._lock:
+            self.meta = dict(meta or {})
 
     # -- paths ---------------------------------------------------------------
     def path_for(self, step: int) -> str:
@@ -89,7 +135,9 @@ class CheckpointManager:
         return sorted(rows, key=lambda r: r["step"])
 
     def _write_manifest(self, rows: List[Dict[str, Any]]) -> None:
-        doc = {"version": 1, "checkpoints": rows}
+        doc: Dict[str, Any] = {"version": 2, "checkpoints": rows}
+        if self.meta:
+            doc["meta"] = self.meta
         path = self._manifest_path()
         tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "w") as f:
@@ -122,6 +170,21 @@ class CheckpointManager:
         return path
 
     # -- resume protocol -----------------------------------------------------
+    def manifest_meta(self) -> Dict[str, Any]:
+        """The manifest's recorded run meta (world size, plan knobs,
+        flat-shard layout), ``{}`` for a manifest written by an older
+        version or lost/corrupt — callers degrade (same-world resume
+        only), they never KeyError."""
+        try:
+            with open(self._manifest_path()) as f:
+                doc = json.load(f)
+            meta = doc.get("meta")
+            if isinstance(meta, dict):
+                return meta
+        except (OSError, ValueError):
+            pass
+        return {}
+
     def latest(self) -> Optional[Tuple[int, str]]:
         """Newest (step, path) whose file passes :func:`checkpoint.verify`
         — corrupt/partial/missing candidates are skipped, so a save that
@@ -137,19 +200,25 @@ class CheckpointManager:
             return int(row["step"]), path
         return None
 
-    def load_latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
-        """Load the newest readable checkpoint: (step, payload), or None
-        when no checkpoint survives verification.  A file that passes
-        the CRC probe but fails the full load (shouldn't happen, but
-        disks lie) is skipped like any other corrupt candidate."""
+    def load_latest(self, *, with_meta: bool = False):
+        """Load the newest readable checkpoint: ``(step, payload)``, or
+        None when no checkpoint survives verification.  A file that
+        passes the CRC probe but fails the full load (shouldn't happen,
+        but disks lie) is skipped like any other corrupt candidate.
+        ``with_meta=True`` appends the manifest meta as a third element
+        (``{}`` for pre-elastic manifests) so resume code sees the
+        saved world size / plan / shard layout in the same read."""
         with self._lock:
             rows = self._read_manifest()
         for row in reversed(rows):
             path = os.path.join(self.directory, row["file"])
             try:
-                return int(row["step"]), _ckpt.load(path)
+                found = int(row["step"]), _ckpt.load(path)
             except (CheckpointError, OSError):
                 continue
+            if with_meta:
+                return found + (self.manifest_meta(),)
+            return found
         return None
 
     def all_steps(self) -> List[int]:

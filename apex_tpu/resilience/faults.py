@@ -15,11 +15,13 @@ Spec grammar (config string or the ``APEX_TPU_FAULTS`` env var)::
 
     entry      := KIND@STEP [ xCOUNT ] [ :ARG ] | seed=N
     KIND       := nan | inf | preempt | loader_stall | collective_fail
+                  | oom | resize
                   (aliases: nan_grads -> nan, inf_grads -> inf,
                    sigterm -> preempt)
     STEP       := first step (0-based) the fault is armed at
     COUNT      := consecutive steps it stays armed (default 1)
-    ARG        := kind-specific float (loader_stall: seconds to stall)
+    ARG        := kind-specific float (loader_stall: seconds to stall;
+                  resize: REQUIRED target world size, e.g. resize@40:4)
 
 Fault kinds and their consumers:
 
@@ -48,6 +50,14 @@ Fault kinds and their consumers:
     shaped like a real XLA report) at the scheduled step, driving the
     OOM post-mortem path: flight-oom dump, then RE-RAISE — an OOM is
     deterministic, so the guard never burns rollback retries on it.
+  * ``resize`` — ``resize@N:M`` simulates the fleet shrinking/growing
+    to ``M`` chips at step ``N``: the guard snapshots and exits clean
+    exactly like ``preempt`` (one-shot, ``skip_until`` honored the
+    same way — it fires BEFORE its step runs), recording the target
+    world size in ``GuardReport.resize_to`` so a harness can bring the
+    run back up at ``M`` chips through ``apex_tpu.elastic``'s
+    checkpoint reshard.  ``M`` is required and must be a positive
+    integer — a resize to nowhere is a spec bug, not a fault.
 
 The module imports neither jax nor the package root at import time, so
 instrumented library code (the data loader) can probe for an active
@@ -61,7 +71,8 @@ import re
 import time
 from typing import List, Optional, Tuple
 
-KINDS = ("nan", "inf", "preempt", "loader_stall", "collective_fail", "oom")
+KINDS = ("nan", "inf", "preempt", "loader_stall", "collective_fail", "oom",
+         "resize")
 _ALIASES = {"nan_grads": "nan", "inf_grads": "inf", "sigterm": "preempt"}
 
 _ENTRY = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
@@ -131,13 +142,14 @@ class FaultPlan:
         interrupted at ``step`` — called by the guard after a resume so
         a plan re-armed from the env in a fresh process doesn't re-fire
         them (a re-firing preempt would wedge the run in a
-        preempt/resume loop).  ``preempt`` fires BEFORE its step runs,
-        so a preempt at exactly ``step`` is elapsed; every other kind
-        fires with its step, so a firing scheduled AT the resume step
-        never ran and stays armed — the resumed run is the faithful
-        continuation of the schedule."""
+        preempt/resume loop).  ``preempt`` and ``resize`` fire BEFORE
+        their step runs, so one at exactly ``step`` is elapsed; every
+        other kind fires with its step, so a firing scheduled AT the
+        resume step never ran and stays armed — the resumed run is the
+        faithful continuation of the schedule."""
         for i, s in enumerate(self.specs):
-            horizon = step - s.step + (1 if s.kind == "preempt" else 0)
+            horizon = step - s.step + (1 if s.kind in ("preempt", "resize")
+                                       else 0)
             if horizon > 0:
                 self._fired[i] = max(self._fired[i],
                                      min(s.count, horizon))
@@ -173,10 +185,14 @@ def parse(spec: str) -> FaultPlan:
         if kind not in KINDS:
             raise FaultError(f"unknown fault kind {m.group('kind')!r}; "
                              f"valid: {KINDS} + aliases {tuple(_ALIASES)}")
+        arg = float(m.group("arg") or 0.0)
+        if kind == "resize" and (arg < 1 or arg != int(arg)):
+            raise FaultError(
+                f"resize needs a positive integer target world size: "
+                f"resize@STEP:M (got {entry!r})")
         specs.append(FaultSpec(
             kind=kind, step=int(m.group("step")),
-            count=int(m.group("count") or 1),
-            arg=float(m.group("arg") or 0.0)))
+            count=int(m.group("count") or 1), arg=arg))
     return FaultPlan(specs, seed=seed)
 
 

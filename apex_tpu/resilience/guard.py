@@ -48,12 +48,15 @@ import queue
 import signal
 import threading
 import time
+import warnings
 from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
 from . import faults as _faults
-from .ckpt import CheckpointManager
+from .ckpt import (CheckpointManager, ManifestCompatWarning,
+                   WorldSizeMismatchError, META_LAYOUT_KEY, META_PLAN_KEY,
+                   META_WORLD_KEY)
 from ..checkpoint import CheckpointError
 
 
@@ -68,6 +71,44 @@ def _env_enabled() -> bool:
     return env_flag("APEX_TPU_GUARD")
 
 
+# -- elastic resharder hook ---------------------------------------------------
+# apex_tpu.elastic.install() registers a process-default resharder here;
+# TrainGuard(elastic=...) pins one per guard.  Anything with a
+# ``resume(template, payload, saved_meta, live_world, emit=...) ->
+# payload`` method qualifies.  Without one, a world-size mismatch at
+# resume is a typed, LOUD failure (WorldSizeMismatchError), never a
+# silent garbage restore.
+
+_RESHARDER = None
+
+
+def set_resharder(resharder):
+    """Install ``resharder`` as the process default (None uninstalls).
+    Returns the previous one so callers can restore it."""
+    global _RESHARDER
+    prev = _RESHARDER
+    _RESHARDER = resharder
+    return prev
+
+
+def get_resharder():
+    return _RESHARDER
+
+
+def _infer_world(state) -> Optional[int]:
+    """The state's mesh size: the device count of the first
+    NamedSharding leaf (a shard_map/pmap-produced step carry is sharded
+    over its mesh — replicated leaves included).  None for plain
+    single-device state, where world-size bookkeeping is meaningless."""
+    import jax
+    from jax.sharding import NamedSharding
+    for leaf in jax.tree_util.tree_leaves(state):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return int(sh.mesh.devices.size)
+    return None
+
+
 @dataclasses.dataclass
 class GuardConfig:
     """Policy knobs for :class:`TrainGuard`.
@@ -80,7 +121,14 @@ class GuardConfig:
     that detector.  ``flight_dir`` is where flight-recorder dumps land
     on rollback/preempt/exception (default: the tracer's own directory,
     else next to the checkpoints).  ``enabled=None`` reads
-    ``APEX_TPU_GUARD`` (default on)."""
+    ``APEX_TPU_GUARD`` (default on).
+
+    ``world_size`` pins the live world recorded in the checkpoint
+    manifest (default: inferred from the state's mesh sharding);
+    ``ckpt_meta`` is extra manifest meta merged in — the elastic-resume
+    contract puts the plan knobs under ``"plan"`` and the
+    ``ShardedUpdate.layout_meta`` dict under ``"layout"`` so a resume
+    at a different chip count can reshard instead of crash."""
     ckpt_dir: Optional[str] = None
     save_every_steps: int = 0
     save_every_seconds: float = 0.0
@@ -94,6 +142,8 @@ class GuardConfig:
     auto_resume: bool = True
     flight_dir: Optional[str] = None
     enabled: Optional[bool] = None
+    world_size: Optional[int] = None
+    ckpt_meta: Optional[dict] = None
 
     def __post_init__(self):
         if self.enabled is None:
@@ -113,6 +163,12 @@ class GuardReport:
     rollbacks: int = 0
     faults_injected: int = 0
     checkpoints: int = 0
+    #: an injected ``resize@N:M`` fault stopped the run: the target
+    #: world size to bring it back up at (via apex_tpu.elastic)
+    resize_to: Optional[int] = None
+    #: the resume crossed a chip-count change and the checkpoint was
+    #: resharded (saved world -> live world)
+    resharded_from: Optional[int] = None
 
 
 def _observed_save(manager: CheckpointManager, step: int, payload,
@@ -213,13 +269,18 @@ class TrainGuard:
     (default: the installed/env plan at each ``run``); ``registry`` pins
     a telemetry registry (default: the process default at emit time);
     ``scaler_fn(state) -> ScalerState`` overrides the auto-probe for the
-    floor detector; ``on_check(step, losses)`` is called with the
+    floor detector; ``elastic`` pins a checkpoint resharder
+    (:class:`apex_tpu.elastic.ElasticResume`; default: whatever
+    ``apex_tpu.elastic.install()`` registered) so a resume across a
+    chip-count change reshards instead of raising
+    :class:`~apex_tpu.resilience.ckpt.WorldSizeMismatchError`;
+    ``on_check(step, losses)`` is called with the
     resolved loss window at every health check (the example loops' print
     hook — the values are already host floats, printing costs nothing
     extra)."""
 
     def __init__(self, step_fn: Callable, config: GuardConfig, *,
-                 plan=None, registry=None, scaler_fn=None,
+                 plan=None, registry=None, scaler_fn=None, elastic=None,
                  on_check: Optional[Callable[[int, List[float]],
                                              None]] = None):
         self.step_fn = step_fn
@@ -227,6 +288,7 @@ class TrainGuard:
         self._plan = plan
         self._registry = registry
         self._scaler_fn = scaler_fn
+        self._elastic = elastic
         self._on_check = on_check
         self._stop = False
         self.manager = (CheckpointManager(config.ckpt_dir,
@@ -338,6 +400,48 @@ class TrainGuard:
         return jax.tree_util.tree_unflatten(
             treedef, [put(t, h) for t, h in zip(leaves, saved)])
 
+    def _maybe_reshard(self, template, payload, saved_meta: dict,
+                       live_world: Optional[int], report) -> dict:
+        """Route a resume whose saved world size differs from the live
+        one through the elastic resharder; same-world (or world-
+        agnostic) resumes pass the payload through untouched.
+
+        No resharder installed -> :class:`WorldSizeMismatchError`,
+        LOUDLY, naming both counts — the alternative is a shape-
+        coincidence restore that silently mis-slices the optimizer
+        shards.  A pre-elastic manifest (no recorded world size /
+        layout) degrades to same-world-only with a typed
+        :class:`ManifestCompatWarning` instead of a KeyError."""
+        resharder = (self._elastic if self._elastic is not None
+                     else get_resharder())
+        saved_world = saved_meta.get(META_WORLD_KEY)
+        if not saved_world or not live_world:
+            if resharder is not None and not saved_meta.get(META_WORLD_KEY):
+                warnings.warn(
+                    "checkpoint manifest records no world size (written "
+                    "by a pre-elastic version): reshard unavailable, "
+                    "same-world resume only", ManifestCompatWarning,
+                    stacklevel=3)
+            return payload
+        saved_world, live_world = int(saved_world), int(live_world)
+        if saved_world == live_world:
+            return payload
+        if resharder is None:
+            raise WorldSizeMismatchError(saved_world, live_world)
+        if not isinstance(saved_meta.get(META_LAYOUT_KEY), dict):
+            warnings.warn(
+                "checkpoint manifest records no flat-shard layout "
+                "(written by a pre-elastic version): reshard "
+                "unavailable, same-world resume only",
+                ManifestCompatWarning, stacklevel=3)
+            raise WorldSizeMismatchError(
+                saved_world, live_world,
+                detail="manifest lacks the flat-shard layout fields")
+        payload = resharder.resume(template, payload, saved_meta,
+                                   live_world, emit=self._emit)
+        report.resharded_from = saved_world
+        return payload
+
     # -- signals -------------------------------------------------------------
     def _install_handlers(self):
         if threading.current_thread() is not threading.main_thread():
@@ -409,10 +513,22 @@ class TrainGuard:
 
         from ..telemetry import trace as _trace
 
+        live_world = cfg.world_size or _infer_world(state)
+        if mgr is not None:
+            meta = {}
+            if live_world:
+                meta[META_WORLD_KEY] = int(live_world)
+            if cfg.ckpt_meta:
+                meta.update(cfg.ckpt_meta)
+            if meta:
+                mgr.set_meta(meta)
+
         if mgr is not None and cfg.auto_resume:
-            found = mgr.load_latest()
+            found = mgr.load_latest(with_meta=True)
             if found is not None and found[0] > start_step:
-                ck_step, payload = found
+                ck_step, payload, saved_meta = found
+                payload = self._maybe_reshard(state, payload, saved_meta,
+                                              live_world, report)
                 with _trace.span("ckpt.restore", step=found[0]):
                     state = self._restore(state, payload)
                 step = min(ck_step, num_steps)
@@ -445,6 +561,18 @@ class TrainGuard:
                                registry=self._registry)
                 report.checkpoints += 1
             while step < num_steps:
+                if plan is not None and not self._stop:
+                    spec = plan.fire("resize", step)
+                    if spec is not None:
+                        # a simulated fleet resize: snapshot-then-clean-
+                        # exit exactly like preempt, remembering the
+                        # target world so the harness restarts at M
+                        # chips and elastic reshards the checkpoint
+                        report.faults_injected += 1
+                        report.resize_to = int(spec.arg)
+                        self._emit("fault_injected", kind="resize",
+                                   step=step, target_world=int(spec.arg))
+                        signal.raise_signal(signal.SIGTERM)
                 if plan is not None and not self._stop \
                         and plan.fire("preempt", step) is not None:
                     report.faults_injected += 1

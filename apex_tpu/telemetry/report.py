@@ -123,6 +123,11 @@ def summarize(records: List[dict]) -> dict:
         "resumes": len(events.get("resumed", ())),
         "preemptions": len(events.get("preempted", ())),
         "sentinel_fires": len(events.get("sentinel.slow_step", ())),
+        # elastic lifecycle (docs/resilience.md Elastic resume): a run
+        # that crossed a chip-count change shows its reshards/replans
+        # on the same resilience line
+        "reshards": len(events.get("elastic.reshard", ())),
+        "replans": len(events.get("elastic.replan", ())),
         # memory (docs/telemetry.md Memory): live allocator high-water
         # from the monitor's mem.* gauges (max over the run — a gauge's
         # last value would under-report a mid-run spike), the
@@ -178,7 +183,8 @@ def format_summary(s: dict) -> str:
     lines.append(f"  loader wait         {_fmt_hist(s['loader_wait_ms'])}")
     res = [(k, s.get(k, 0)) for k in ("faults_injected", "rollbacks",
                                       "resumes", "preemptions",
-                                      "sentinel_fires")]
+                                      "sentinel_fires", "reshards",
+                                      "replans")]
     if any(n for _, n in res):
         lines.append("  resilience          "
                      + "  ".join(f"{k.replace('_', ' ')} {n}"

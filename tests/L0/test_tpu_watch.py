@@ -47,6 +47,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_US_CMD": "",
            # and the auto-parallel plan A/B (stage 2d)
            "APEX_WATCH_PLAN_CMD": "",
+           # and the elastic kill-N-resume-M proof (stage 3b)
+           "APEX_WATCH_ELASTIC_CMD": "",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
            **env_extra}
@@ -502,6 +504,53 @@ def test_plan_ab_stage_artifact_and_span(tmp_path):
     assert "plan A/B done rc=1" in log3
     assert not (tmp_path / "PLAN_FAIL.json").exists()
     assert not (tmp_path / "PLAN_FAIL.json.run").exists()
+
+
+def test_elastic_stage_artifact_and_span(tmp_path):
+    """ISSUE 11 satellite: the elastic kill-8-resume-4 proof runs as
+    watch stage 3b — artifact written atomically, `watch.elastic` span
+    appended to the streaming timeline, skip-when-complete, and a
+    failing proof leaves no truncated artifact behind (mirror of
+    stages 2b-2d)."""
+    fake = json.dumps({"metric": "elastic_proof", "backend": "tpu",
+                       "from_world": 8, "to_world": 4, "bitwise": True})
+    marker = tmp_path / "elastic_calls"
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    }
+    r, log = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_ELASTIC_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    art = json.loads((tmp_path / "ELASTIC_PROOF_r5.json").read_text())
+    assert art["bitwise"] is True and art["to_world"] == 4
+    assert "elastic proof done rc=0" in log
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.elastic" in names
+    # second window: artifact present -> stage skipped
+    r2, _ = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_ELASTIC_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+
+    # a failing proof (rc!=0: the bitwise gate) leaves no truncated
+    # artifact behind, and a later window retries
+    r3, log3 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_ELASTIC_JSON": "ELASTIC_FAIL.json",
+        "APEX_WATCH_ELASTIC_CMD": "echo '{\"bitwise\":false'; false",
+    })
+    assert r3.returncode == 0
+    assert "elastic proof done rc=1" in log3
+    assert not (tmp_path / "ELASTIC_FAIL.json").exists()
+    assert not (tmp_path / "ELASTIC_FAIL.json.run").exists()
 
 
 def test_stage_spans_record_failures_too(tmp_path):

@@ -85,6 +85,16 @@ GTRAIN_CMD=${APEX_WATCH_GTRAIN_CMD:-"python examples/imagenet/main_amp.py --arch
 GTRAIN_LOG=${APEX_WATCH_GTRAIN_LOG:-TRAIN_GUARD_r5.txt}
 GTRAIN_TO=${APEX_WATCH_GTRAIN_TO:-900}
 GTRAIN_DONE=${APEX_WATCH_GTRAIN_DONE:-TRAIN_GUARD_DONE}
+# stage 3b: the elastic kill-8-resume-4 proof (ISSUE 11) — train N-way
+# with zero1+int8-EF, kill with an injected resize fault, resume
+# N/2-way through apex_tpu.elastic, assert the final params BITWISE
+# match a clean resumed run from the same checkpoint.  One JSON line on
+# stdout, captured atomically (.run then mv — a wedge never leaves a
+# truncated artifact).  ${VAR-default}: an explicitly EMPTY override
+# disables the stage
+ELASTIC_CMD=${APEX_WATCH_ELASTIC_CMD-"python tools/elastic_proof.py"}
+ELASTIC_JSON=${APEX_WATCH_ELASTIC_JSON:-ELASTIC_PROOF_r5.json}
+ELASTIC_TO=${APEX_WATCH_ELASTIC_TO:-400}
 # stage 2b: collective-scheme A/B (fp32 vs bf16/int8/adasum wire bytes +
 # host ms, ISSUE 7) — cheap enough for a short window, and the artifact
 # feeds apply_perf_results' ddp_collective_scheme decision
@@ -306,6 +316,21 @@ for i in $(seq 1 "$N_PROBES"); do
         # remaining stages either way
         echo "$(date +%H:%M:%S) guard train leg incomplete; checkpoints carry progress to the next window" >> "$LOG"
       fi
+    fi
+    # ---- stage 3b: elastic kill-N-resume-M proof (skip-when-complete) ----
+    if [ -n "$ELASTIC_CMD" ] && [ ! -s "$ELASTIC_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$ELASTIC_TO" bash -c "$ELASTIC_CMD" > "$ELASTIC_JSON".run 2>> "$LOG"
+      rce=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span elastic "$t0" "$rce"
+      stage_mem
+      if [ $rce -eq 0 ] && [ -s "$ELASTIC_JSON".run ]; then
+        mv "$ELASTIC_JSON".run "$ELASTIC_JSON"
+      else
+        # a wedged/failed proof never leaves a truncated artifact behind
+        rm -f "$ELASTIC_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) elastic proof done rc=$rce" >> "$LOG"
     fi
     # ---- stage 3: training run with save/resume (numerics proof) ----
     # AFTER the incremental bench stages: an all-or-nothing TRAIN_TO-long
