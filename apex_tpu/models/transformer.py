@@ -143,9 +143,17 @@ def transformer_pspecs(cfg: TransformerConfig, *, dp="data", tp="model"):
 
 
 def _attention(x, wqkv, bqkv, wo, bo, cfg: TransformerConfig, mask,
-               dropout_rng=None):
+               dropout_rng=None, attn_override=None):
     """Self-attention reference path (jnp; XLA fuses).  The contrib fast
-    Pallas kernel slots in behind the same signature."""
+    Pallas kernel slots in behind the same signature.
+
+    ``attn_override``: a callable ``(q, k, v, *, causal) -> ctx`` over the
+    (B, H, S, D) head layout that replaces the score/softmax core — the
+    hook the sequence-parallel step engine (``parallel.spmd``) uses to
+    route attention through ``ring_attention``/``ulysses_attention``
+    inside shard_map.  The override owns the 1/sqrt(D) scaling (both
+    sequence collectives scale internally); masks are not supported
+    through the hook (the sp engine trains unpadded batches)."""
     B, S, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     qkv = jnp.einsum("bsd,de->bse", x, wqkv.astype(x.dtype)) + bqkv.astype(x.dtype)
@@ -153,6 +161,15 @@ def _attention(x, wqkv, bqkv, wo, bo, cfg: TransformerConfig, mask,
     q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    if attn_override is not None:
+        if mask is not None:
+            raise ValueError(
+                "attn_override does not compose with a key-padding mask "
+                "(the sequence-parallel collectives carry no mask plumbing)")
+        ctx = attn_override(q, k, v, causal=cfg.causal)
+        ctx = ctx.astype(x.dtype).transpose(0, 2, 1, 3).reshape(B, S, D)
+        return jnp.einsum("bsd,de->bse", ctx, wo.astype(x.dtype)) \
+            + bo.astype(x.dtype)
     if cfg.attn_impl == "fast":
         from ..contrib.multihead_attn.flash import flash_attention
         from ..contrib.multihead_attn.modules import _rng_seed_from
@@ -195,7 +212,8 @@ def _attention(x, wqkv, bqkv, wo, bo, cfg: TransformerConfig, mask,
     return jnp.einsum("bsd,de->bse", ctx, wo.astype(x.dtype)) + bo.astype(x.dtype)
 
 
-def _layer(x, lp, cfg: TransformerConfig, mask, dropout_rng):
+def _layer(x, lp, cfg: TransformerConfig, mask, dropout_rng,
+           attn_override=None):
     """Pre-LN transformer block (the contrib norm-add layout,
     ``apex/contrib/multihead_attn/self_multihead_attn.py`` norm-add variant)."""
     dt = x.dtype
@@ -205,7 +223,7 @@ def _layer(x, lp, cfg: TransformerConfig, mask, dropout_rng):
     if dropout_rng is not None:
         dropout_rng, r1 = jax.random.split(dropout_rng)
     x = x + _attention(h, lp["wqkv"], lp["bqkv"], lp["wo"], lp["bo"], cfg,
-                       mask, r1)
+                       mask, r1, attn_override)
     h = fused_layer_norm_affine(x, lp["ln2_g"].astype(dt), lp["ln2_b"].astype(dt),
                                 (cfg.d_model,))
     h = jnp.einsum("bsd,df->bsf", h, lp["w1"].astype(dt)) + lp["b1"].astype(dt)
@@ -215,16 +233,29 @@ def _layer(x, lp, cfg: TransformerConfig, mask, dropout_rng):
 
 
 def transformer_apply(params, tokens, cfg: TransformerConfig, *,
-                      mask=None, dropout_rng=None):
+                      mask=None, dropout_rng=None, attn_override=None,
+                      pos_offset=None):
     """tokens (B, S) int32 -> logits (B, S, V).  Layers run under lax.scan
     over the stacked L axis.  ``mask``: optional key-padding mask (B, S),
-    nonzero = PAD (same polarity as contrib.multihead_attn)."""
+    nonzero = PAD (same polarity as contrib.multihead_attn).
+
+    ``attn_override``/``pos_offset`` are the sequence-parallel hooks
+    (``parallel.spmd``): the override replaces every layer's attention
+    core (see :func:`_attention`), and ``pos_offset`` (a traced int, the
+    device's global position of its first local token) shifts the
+    position-embedding slice so a sequence-sharded device reads ITS
+    positions, not [0, S_local)."""
     if cfg.attn_impl not in ("default", "fast"):
         raise ValueError(
             f"attn_impl must be 'default' or 'fast', got {cfg.attn_impl!r}")
     emb = params["embed"]
     dt = cfg.dtype
-    x = emb["tok"][tokens].astype(dt) + emb["pos"][: tokens.shape[1]][None].astype(dt)
+    if pos_offset is None:
+        pos = emb["pos"][: tokens.shape[1]]
+    else:
+        pos = jax.lax.dynamic_slice_in_dim(emb["pos"], pos_offset,
+                                           tokens.shape[1])
+    x = emb["tok"][tokens].astype(dt) + pos[None].astype(dt)
     x = fused_layer_norm_affine(x, emb["ln_g"].astype(dt),
                                 emb["ln_b"].astype(dt), (cfg.d_model,))
 
@@ -245,10 +276,11 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
             # prevent_cse=False: scan already blocks the CSE that the
             # default barriers defend against (per the jax.checkpoint docs)
             layer = jax.checkpoint(
-                functools.partial(_layer, cfg=cfg, mask=mask),
+                functools.partial(_layer, cfg=cfg, mask=mask,
+                                  attn_override=attn_override),
                 prevent_cse=False)
             return layer(carry, lp, dropout_rng=rng), None
-        return layer(carry, lp, cfg, mask, rng), None
+        return layer(carry, lp, cfg, mask, rng, attn_override), None
 
     xs = (params["layers"], layer_rngs) if layer_rngs is not None \
         else params["layers"]
@@ -262,14 +294,18 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
 
 
 def transformer_loss(params, batch, cfg: TransformerConfig, *,
-                     dropout_rng=None, smoothing=0.0):
+                     dropout_rng=None, smoothing=0.0, attn_override=None,
+                     pos_offset=None):
     """Masked-LM style cross-entropy via the contrib fused xentropy kernel.
     batch: dict(tokens (B,S) int32, targets (B,S) int32,
-    weights optional (B,S) f32)."""
+    weights optional (B,S) f32).  ``attn_override``/``pos_offset``
+    thread through to :func:`transformer_apply` (sequence parallelism)."""
     from ..contrib.xentropy import softmax_xentropy_loss
     logits = transformer_apply(params, batch["tokens"], cfg,
                                mask=batch.get("mask"),
-                               dropout_rng=dropout_rng)
+                               dropout_rng=dropout_rng,
+                               attn_override=attn_override,
+                               pos_offset=pos_offset)
     B, S, V = logits.shape
     # padding_idx=-1: padding is expressed through ``weights``, and vocab id 0
     # is a legitimate target here (unlike the reference's seq2seq pad=0)

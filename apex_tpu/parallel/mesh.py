@@ -208,6 +208,17 @@ def shard_map(f, **kwargs):
     return _sm(f, **kwargs)
 
 
+def num_slices(devices: Optional[Sequence] = None) -> int:
+    """Distinct TPU slices among ``devices`` (default: all).  Multislice
+    pods expose ``device.slice_index``; collectives crossing slices ride
+    DCN, not ICI — the fact the planner's alpha-beta model
+    (``plan.collective_time_s``) needs to charge DCN terms.  Single-slice
+    and non-TPU backends report 1."""
+    if devices is None:
+        devices = jax.devices()
+    return len({getattr(d, "slice_index", 0) for d in devices}) or 1
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -298,13 +298,33 @@ def _codec_bytes(scheme: str, logical_bytes: float, world: int,
     return 0.0
 
 
+def _ab_time(kind: str, wire: float, world: int, alpha: float,
+             bw: float) -> float:
+    """One alpha-beta term: hops x launch latency + ring traffic over
+    the link (``wire`` = this tier's per-device wire payload)."""
+    if world <= 1 or wire <= 0:
+        return 0.0
+    return (_COLL_HOPS[kind](world) * alpha
+            + _COLL_TRAFFIC[kind](world) * wire / bw)
+
+
 def collective_time_s(kind: str, logical_bytes: float, world: int,
                       ceil: dict, scheme: str = "fp32",
-                      block: int = _coll.DEFAULT_BLOCK) -> float:
+                      block: int = _coll.DEFAULT_BLOCK,
+                      slices: int = 1) -> float:
     """Alpha-beta time for one collective of ``logical_bytes`` (fp32
     payload per device) over a ``world``-sized axis: per-hop launch
     latency + ring traffic of the scheme's WIRE representation over the
-    link bandwidth + the codec's HBM passes."""
+    link bandwidth + the codec's HBM passes.
+
+    ``slices > 1`` models a multi-slice axis (the dp axis of a
+    multislice pod): the collective decomposes hierarchically into the
+    intra-slice phase over ``world/slices`` neighbors on ICI plus an
+    inter-slice phase over ``slices`` carrying ``1/local`` of the
+    payload per device across DCN (``dcn_bw``/``dcn_alpha_s`` ceilings
+    — the classic RS-local / AR-across / AG-local schedule).  Slices
+    that don't divide the axis fall back to the flat single-tier
+    model."""
     if world <= 1 or logical_bytes <= 0:
         return 0.0
     if kind not in _COLL_HOPS:
@@ -312,8 +332,17 @@ def collective_time_s(kind: str, logical_bytes: float, world: int,
                          f"known: {tuple(_COLL_HOPS)}")
     nelems = int(logical_bytes) // 4
     wire = float(_coll.wire_bytes(scheme, nelems, block))
-    t = (_COLL_HOPS[kind](world) * ceil["ici_alpha_s"]
-         + _COLL_TRAFFIC[kind](world) * wire / ceil["ici_bw"])
+    slices = int(slices or 1)
+    if slices > 1 and world % slices == 0 and world > slices:
+        local = world // slices
+        dcn_bw = ceil.get("dcn_bw", ceil["ici_bw"])
+        dcn_alpha = ceil.get("dcn_alpha_s", ceil["ici_alpha_s"])
+        t = (_ab_time(kind, wire, local, ceil["ici_alpha_s"],
+                      ceil["ici_bw"])
+             + _ab_time(kind, wire / local, slices, dcn_alpha, dcn_bw))
+    else:
+        t = _ab_time(kind, wire, world, ceil["ici_alpha_s"],
+                     ceil["ici_bw"])
     return t + _codec_bytes(scheme, logical_bytes, world,
                             kind) / ceil["peak_bw"]
 
@@ -370,12 +399,28 @@ class Plan:
                 + (self.allgather_scheme != "fp32"))
 
     @property
+    def family(self) -> str:
+        """Which step engine (``parallel.spmd``) materializes this plan
+        — also the one-point-calibration bucket ``bench.py --plan``
+        uses: ``zero`` (contrib ZeRO) / ``tp`` (consistent-SPMD GSPMD
+        jit) / ``sp`` (ring/ulysses shard_map) / ``dp`` (the classic
+        DDP harness)."""
+        if self.zero:
+            return "zero"
+        if self.tp > 1:
+            return "tp"
+        if self.sp > 1:
+            return "sp"
+        return "dp"
+
+    @property
     def measurable(self) -> bool:
-        """Can ``bench.py --plan`` time this plan with today's training
-        harness?  The dp family (scheme / update-sharding knobs on the
-        DDP path) is; tp/sp/ZeRO plans carry predictions only until
-        their step harnesses exist."""
-        return self.tp == 1 and self.sp == 1 and not self.zero
+        """Can ``bench.py --plan`` time this plan?  True across the
+        whole search space since the ``parallel.spmd`` step engine
+        (ISSUE 12): every family — dp, dp x tp (GSPMD), dp x sp
+        (ring/ulysses), contrib-ZeRO — materializes as a runnable step
+        via :func:`~apex_tpu.parallel.spmd.build_plan_step`."""
+        return self.family in ("dp", "tp", "sp", "zero")
 
     def axis_sizes(self) -> Dict[str, int]:
         """``create_mesh`` axis dict — size-1 axes are omitted (except
@@ -410,14 +455,11 @@ class Plan:
 
     def pspecs(self, cfg):
         """PartitionSpec tree for the flagship transformer under this
-        plan (replicated when tp == 1 — dp grads ride the DDP psum)."""
-        import jax
-        from jax.sharding import PartitionSpec as P
-        from ..models import transformer_init, transformer_pspecs
-        if self.tp > 1:
-            return transformer_pspecs(cfg, dp=DATA_AXIS, tp=MODEL_AXIS)
-        params = transformer_init(jax.random.PRNGKey(0), cfg)
-        return jax.tree_util.tree_map(lambda _: P(), params)
+        plan (replicated when tp == 1 — dp grads ride the DDP psum).
+        Single source: the step engine's
+        :func:`~apex_tpu.parallel.spmd.plan_param_pspecs`."""
+        from . import spmd as _spmd
+        return _spmd.plan_param_pspecs(cfg, self)
 
     @contextlib.contextmanager
     def apply(self, devices=None):
@@ -514,16 +556,25 @@ def predict(profile: ModelProfile, plan: Plan, ceilings=None,
 
     t_dp = 0.0
     if dp > 1:
+        # only the dp axis can span slices (tp/sp are ICI-adjacent by
+        # construction — the mesh's fastest axes); a multi-slice pod
+        # charges the dp wire its DCN tier (``num_slices`` rides the
+        # ceilings: detected from the device topology by search(), or
+        # pinned via APEX_TPU_CEILINGS="num_slices=N")
+        dp_slices = min(dp, int(ceil.get("num_slices", 1) or 1))
         gbytes = profile.grad_bytes / tp
         if plan.shards_update:
             t_dp = (collective_time_s("reduce_scatter", gbytes, dp, ceil,
-                                      plan.collective_scheme)
+                                      plan.collective_scheme,
+                                      slices=dp_slices)
                     + collective_time_s("all_gather",
                                         profile.params_bytes / tp, dp,
-                                        ceil, plan.allgather_scheme))
+                                        ceil, plan.allgather_scheme,
+                                        slices=dp_slices))
         else:
             t_dp = collective_time_s("all_reduce", gbytes, dp, ceil,
-                                     plan.collective_scheme)
+                                     plan.collective_scheme,
+                                     slices=dp_slices)
 
     t_tp = 0.0
     if tp > 1:
@@ -607,12 +658,21 @@ def enumerate_plans(profile: ModelProfile, chips: int, *,
             strategies = ["none"]
         # sharding variants: plain DDP; update-sharded DDP (zero1); the
         # contrib-ZeRO route.  The wire scheme only matters with a dp
-        # axis to exchange over.
+        # axis to exchange over.  Engine constraints (parallel.spmd):
+        # contrib ZeRO is a shard_map-over-data optimizer — it composes
+        # with neither the GSPMD tp step nor the (data, seq) sp step;
+        # and the tp family's dp wire is XLA-owned (consistent-SPMD:
+        # collectives by annotation), so compressed schemes don't
+        # apply there — a plan the engine cannot run must not be
+        # enumerated, let alone ranked.
         variants = [("off", False)]
         if dp > 1:
-            variants += [("zero1", False), ("off", True)]
+            variants.append(("zero1", False))
+            if tp == 1 and sp == 1:
+                variants.append(("off", True))
+        dp_schemes = schemes if (dp > 1 and tp == 1) else ("fp32",)
         for strat in strategies:
-            for scheme in (schemes if dp > 1 else ("fp32",)):
+            for scheme in dp_schemes:
                 for us, zero in variants:
                     plans.append(predict(profile, Plan(
                         dp=dp, tp=tp, sp=sp, sp_strategy=strat,
@@ -634,6 +694,14 @@ def search(profile: ModelProfile, chips: int, *,
     ceil = dict(_resolve_ceil(ceilings, platform or profile.platform))
     if capacity_bytes is not None:
         ceil["hbm_bytes"] = float(capacity_bytes)
+    if "num_slices" not in ceil:
+        # multi-slice detection from the live device topology (DCN
+        # terms for the dp wire); explicit ceilings/env always win
+        from .mesh import num_slices as _num_slices
+        try:
+            ceil["num_slices"] = _num_slices()
+        except Exception:   # pragma: no cover - uninitialized backend
+            ceil["num_slices"] = 1
     plans = [p for p in enumerate_plans(profile, chips, ceilings=ceil,
                                         **enum_kwargs) if p.feasible]
     plans.sort(key=lambda p: p.predicted_step_ms)
@@ -741,7 +809,7 @@ def build_flagship_step(cfg, mesh, *, global_batch: int,
 #: the loop cannot drift
 TUNING_KEYS = ("plan_dp", "plan_tp", "plan_sp", "plan_sp_strategy",
                "plan_zero", "plan_update_sharding",
-               "plan_collective_scheme")
+               "plan_collective_scheme", "plan_allgather_scheme")
 
 #: elastic re-plan hook: ``hook(tuned_plan, chips) -> Optional[Plan]``.
 #: ``apex_tpu.elastic.install()`` registers one so a tuned plan whose
@@ -787,6 +855,7 @@ def from_tuning(chips: Optional[int] = None, *,
         zero=bool(get("plan_zero", False)),
         update_sharding=get("plan_update_sharding", "off"),
         collective_scheme=get("plan_collective_scheme", "fp32"),
+        allgather_scheme=get("plan_allgather_scheme", "fp32"),
     )
     if chips is not None and plan.chips != int(chips):
         if _REPLAN_HOOK is not None:

@@ -34,6 +34,35 @@ from ..utils.pallas import _to_varying
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+class SequenceShardingError(ValueError):
+    """A sequence-parallel structural constraint is violated (heads vs
+    the Ulysses all-to-all, sequence length vs the ring chunking).
+    Raised eagerly with the offending numbers in the message — the
+    alternative is a cryptic reshape/all_to_all shape error several
+    stack frames downstream."""
+
+
+def validate_sp(seq: int, heads: int, sp: int, strategy: str) -> None:
+    """Pre-trace validation for a sequence-parallel plan: ``seq`` must
+    chunk evenly over ``sp`` devices (both ring and Ulysses shard the
+    sequence), and Ulysses additionally re-shards heads, so ``heads``
+    must divide over ``sp``.  Raises :class:`SequenceShardingError`
+    naming the numbers."""
+    if sp <= 1:
+        return
+    if seq % sp:
+        raise SequenceShardingError(
+            f"sequence length {seq} does not chunk over sp={sp} devices "
+            f"({seq} % {sp} != 0) — ring/Ulysses sequence parallelism "
+            "needs equal per-device sequence blocks")
+    if strategy == "ulysses" and heads % sp:
+        raise SequenceShardingError(
+            f"num_heads {heads} does not divide over sp={sp} devices "
+            f"({heads} % {sp} != 0) — the Ulysses all-to-all re-shards "
+            "sequence -> heads; use ring attention or an sp that divides "
+            "the head count")
+
+
 def _block_attn(q, k, v, *, causal, q_off, k_off, m, l, acc):
     """Fold one k/v block into the running online softmax.
     q (B, H, Sq, D); k/v (B, H, Sk, D); m/l (B, H, Sq); acc like q@v."""
@@ -111,7 +140,11 @@ def ulysses_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     n = lax_axis_size(axis_name)
     B, H, S_local, D = q.shape
     if H % n:
-        raise ValueError(f"num_heads {H} must divide over seq axis size {n}")
+        raise SequenceShardingError(
+            f"num_heads {H} does not divide over seq axis size {n} "
+            f"({H} % {n} != 0) — the Ulysses all-to-all re-shards "
+            "sequence -> heads; use ring attention or a head count the "
+            "axis divides")
     if scale is None:
         scale = 1.0 / (D ** 0.5)
 

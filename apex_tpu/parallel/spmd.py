@@ -1,0 +1,451 @@
+"""GSPMD step engine: materialize ANY auto-parallel :class:`~apex_tpu.
+parallel.plan.Plan` as an executable, measurable train step (ISSUE 12;
+ROADMAP open item 1).
+
+The PR-10 planner ranks dp x tp(x sp) / ZeRO / update-sharding plans but
+could only *run* the dp family — tp/sp/contrib-ZeRO rankings were
+modeled, never measured.  This module closes that gap with one engine
+per plan family, all behind :func:`build_plan_step`:
+
+``dp`` (tp == sp == 1, no ZeRO)
+    The existing shard_map harness
+    (:func:`~apex_tpu.parallel.plan.build_flagship_step`): explicit DDP
+    psum / weight-update sharding, compressed collective schemes,
+    bitwise-proven against hand configuration.
+``tp`` (tp > 1) — the consistent-SPMD posture (veScale, arXiv:2509.07003)
+    A plain ``jax.jit`` over GLOBAL arrays with ``NamedSharding``
+    annotations: params/activations carry the Megatron
+    ``transformer_pspecs`` 2-D dp x tp specs, and the fused-flat
+    master/moment buffers are sharded 1-D over tp (and additionally
+    over dp when the plan shards the update — ZeRO-1 via GSPMD), with
+    the flattener's chunk lattice pinned to ``LANE * shard_world`` so
+    every tp slice falls on whole 128-lanes.  XLA inserts every
+    collective (the dp grad psum, the Megatron activation psums, the
+    flat-buffer reshards); single-device semantics are preserved by
+    construction — the global loss IS the global-batch mean.  The wire
+    is XLA-owned, so compressed schemes don't apply here (the planner
+    enumerates tp plans at fp32 wire only) and the collective payloads
+    are metered from the *compiled HLO* (``tp.psum`` family) — which is
+    also how the alpha-beta comm model is validated against reality.
+``sp`` (sp > 1)
+    shard_map over (data, seq): activations sequence-sharded, attention
+    routed through the existing :func:`~apex_tpu.parallel.sequence.
+    ring_attention` / :func:`~apex_tpu.parallel.sequence.
+    ulysses_attention` collectives via the ``attn_override`` hook in
+    :func:`~apex_tpu.models.transformer_apply` (position embeddings
+    sliced at each device's global offset), grads folded over the seq
+    axis then reduced over dp on the normal DDP wire (compressed
+    schemes and zero1 update sharding both apply).  Compiled
+    ``sp.all_to_all`` / ``sp.ppermute`` payloads are metered.
+``zero`` (contrib ZeRO)
+    shard_map over data with the
+    :class:`~apex_tpu.contrib.optimizers.DistributedFusedAdam` route —
+    permanently sharded optimizer state, the reduce-scatter /
+    allgather wire riding the plan's collective scheme.
+
+amp O-level master weights: every fused-flat engine keeps the fp32
+master buffer authoritative; ``amp_dtype="bfloat16"`` runs the model
+copy (and activations) at bf16 off the same master — the O2 contract —
+with the overflow-skip select keeping non-finite steps out of the
+master, exactly like the dp harness.
+
+``bench.py --plan`` drives this engine for every ranked candidate (one-
+point calibration per family), and ``bench.py --spmd`` A/Bs one
+representative per family against the dp baseline with the compiled
+collective sub-table embedded.  See docs/parallel.md "SPMD step
+engine".
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+__all__ = ["build_plan_step", "plan_param_pspecs", "compiled_collectives",
+           "meter_compiled_collectives", "SPMD_FAMILIES"]
+
+#: plan families the engine materializes (Plan.family values)
+SPMD_FAMILIES = ("dp", "tp", "sp", "zero")
+
+
+def plan_param_pspecs(cfg, plan):
+    """Param PartitionSpec tree for ``cfg`` under ``plan``: the Megatron
+    dp x tp specs when tp > 1, fully replicated otherwise (dp grads ride
+    the explicit DDP collectives; sp shards activations, not params)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from ..models import transformer_init, transformer_pspecs
+    if plan.tp > 1:
+        return transformer_pspecs(cfg, dp=DATA_AXIS, tp=MODEL_AXIS)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    return jax.tree_util.tree_map(lambda _: P(), params)
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO collective metering (tp.psum / sp.all_to_all families)
+# ---------------------------------------------------------------------------
+
+def compiled_collectives(fn, *args, **kwargs) -> dict:
+    """The compiled program's per-opcode collective payloads (AOT — the
+    function is lowered and compiled, never executed): ``{opcode:
+    {count, logical_bytes}}`` from :func:`~apex_tpu.telemetry.attrib.
+    collectives_table`.  Under SPMD the logical bytes are PER-PARTITION
+    (each device's payload), which is exactly what the alpha-beta model
+    predicts per device — the validation surface."""
+    from ..telemetry import attrib
+    table = attrib.op_table(fn, *args, **kwargs)
+    return {op: {"count": agg["count"],
+                 "logical_bytes": agg["logical_bytes"]}
+            for op, agg in (table.get("collectives", {})
+                            .get("by_opcode", {})).items()}
+
+
+#: compiled opcode -> (family, op) for the model-parallel meter families.
+#: all-reduce under a tp plan is the fused dp-grad + Megatron-activation
+#: psum traffic (GSPMD owns the wire; the split is not recoverable from
+#: the compiled module, so the family meters the whole all-reduce
+#: payload — the quantity the comm model must account for in total).
+#: NOTE the entry-computation walk does not see collectives inside
+#: while/scan bodies (the layer scan) — the sp engine therefore meters
+#: its per-layer ring/ulysses wire from its STATIC schedule instead
+#: (:func:`_sp_schedule_bytes`), where layers and shapes are exact.
+_METER_OPS = {
+    "tp": {"all-reduce": ("tp", "psum")},
+    "sp": {"all-to-all": ("sp", "all_to_all"),
+           "collective-permute": ("sp", "ppermute")},
+}
+
+
+def _sp_schedule_bytes(cfg, strategy: str, n_dp: int, n_sp: int,
+                       global_batch: int) -> dict:
+    """Static per-device wire bytes of one sp train step — the engine's
+    exact collective schedule (the scan body hides these from the
+    compiled-HLO entry walk): ulysses ships 4 all_to_alls of one local
+    (B_local, H, S_local, hd) block per layer forward + the mirrored
+    backward; ring rotates the K and V blocks around the full ring each
+    layer, forward and backward."""
+    import jax.numpy as jnp
+    esize = jnp.dtype(cfg.dtype).itemsize
+    blk = ((global_batch // n_dp) * cfg.num_heads
+           * (cfg.max_len // n_sp) * cfg.head_dim * esize)
+    layers = max(int(cfg.num_layers), 1)
+    if strategy == "ulysses":
+        return {"op": "all_to_all",
+                "logical_bytes": 8 * layers * blk,
+                "per_layer_block_bytes": blk, "layers": layers}
+    return {"op": "ppermute",
+            "logical_bytes": 4 * layers * n_sp * blk,
+            "per_layer_block_bytes": blk, "layers": layers}
+
+
+def meter_compiled_collectives(by_opcode: dict, family: str,
+                               axis_name: str) -> dict:
+    """Record the compiled collective payloads through
+    :func:`~apex_tpu.telemetry.events.record_collective` under the
+    model-parallel families (``tp.psum`` / ``sp.all_to_all`` /
+    ``sp.ppermute``) so a run's tp/sp wire bytes are provable from the
+    JSONL exactly like the ddp/zero wires.  Returns the subset of
+    ``by_opcode`` that was metered."""
+    from ..telemetry import events as _tel_events
+    mapping = _METER_OPS.get(family, {})
+    metered = {}
+    for opcode, agg in (by_opcode or {}).items():
+        if opcode not in mapping:
+            continue
+        fam, op = mapping[opcode]
+        _tel_events.record_collective(
+            axis_name, int(agg["logical_bytes"]), int(agg["count"]), 0.0,
+            wire_bytes=int(agg["logical_bytes"]), scheme="fp32",
+            dtype="float32", op=op, family=fam)
+        metered[opcode] = dict(agg)
+    return metered
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+def build_plan_step(cfg, mesh, plan, *, global_batch: int, lr: float = 1e-2,
+                    amp_dtype=None, meter: bool = True):
+    """Materialize ``plan`` as an executable train step over ``mesh``.
+
+    Returns ``(carry0, step, info)`` with ``step(carry, tokens) ->
+    (carry, loss)`` (tokens ``(global_batch, seq)`` int32, loss the
+    scalar global-batch mean) and ``info`` carrying ``family``,
+    ``engine``, and — for the tp/sp engines with ``meter=True`` — the
+    compiled-HLO ``collectives`` sub-table (also recorded through the
+    telemetry ``tp.psum`` / ``sp.all_to_all`` meter families).
+
+    The mesh must carry the plan's axes (``plan.axis_sizes()`` — what
+    ``Plan.apply()`` builds); knobs without an engine argument resolve
+    through their existing env surfaces, which ``Plan.apply()`` sets.
+    ``amp_dtype="bfloat16"`` selects the O2-style bf16 model copy over
+    the fp32 master (fused-flat engines only)."""
+    from .plan import Plan  # noqa: F401  (typing/doc aid; no cycle at import)
+    family = plan.family
+    if plan.zero:
+        return _build_zero_step(cfg, mesh, plan, global_batch, lr, meter)
+    if plan.tp > 1:
+        return _build_gspmd_step(cfg, mesh, plan, global_batch, lr,
+                                 amp_dtype, meter)
+    if plan.sp > 1:
+        return _build_sp_step(cfg, mesh, plan, global_batch, lr, meter)
+    from .plan import build_flagship_step
+    carry0, step = build_flagship_step(cfg, mesh, global_batch=global_batch)
+    return carry0, step, {"family": family, "engine": "shard_map.dp"}
+
+
+def _build_gspmd_step(cfg, mesh, plan, global_batch, lr, amp_dtype, meter):
+    """The consistent-SPMD tp engine (see module docstring): one
+    ``jax.jit`` over global arrays, shardings by annotation only."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..models import transformer_init, transformer_loss
+    from ..multi_tensor_apply.flattener import LANE
+    from ..optimizers import FusedAdam
+
+    n_dp = int(mesh.shape[DATA_AXIS])
+    n_tp = int(mesh.shape.get(MODEL_AXIS, 1))
+    if global_batch % n_dp:
+        raise ValueError(f"global batch {global_batch} must divide over "
+                         f"the data axis ({n_dp})")
+    if cfg.num_heads % n_tp:
+        raise ValueError(f"num_heads {cfg.num_heads} must divide over the "
+                         f"model axis ({n_tp}) — the attention shard unit")
+    # the Pallas attention/xentropy kernels have no GSPMD partitioning
+    # rule (they partition under shard_map, which the dp/sp/zero engines
+    # use); the consistent-SPMD step runs the XLA paths
+    run_cfg = dataclasses.replace(cfg, attn_impl="default", xent_impl="xla")
+    if amp_dtype is not None:
+        run_cfg = dataclasses.replace(run_cfg, dtype=jnp.dtype(amp_dtype))
+
+    params0 = transformer_init(jax.random.PRNGKey(0), run_cfg)
+    pspecs = plan_param_pspecs(run_cfg, plan)
+    is_p = lambda x: isinstance(x, P)
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), pspecs, is_leaf=is_p)
+
+    opt = FusedAdam(lr=lr, impl="fused")
+    # chunk lattice: the flat total divides into whole 128-lane slices
+    # for EVERY axis that shards the flat buffers, so tp (and zero1's
+    # dp) slices never split a lane
+    flat_world = n_tp * (n_dp if plan.shards_update else 1)
+    fl = opt.flattener_for(params0, chunk=LANE * flat_world)
+    flat_axes = ((MODEL_AXIS, DATA_AXIS) if plan.shards_update
+                 else (MODEL_AXIS,))
+    flat_sh = NamedSharding(mesh, P(flat_axes))
+    rep_sh = NamedSharding(mesh, P())
+    state0 = opt.init(params0)
+    state_sh = type(state0)(count=rep_sh, m=flat_sh, v=flat_sh,
+                            master=flat_sh)
+    state0 = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), state0, state_sh)
+    tok_sh = NamedSharding(mesh, P(DATA_AXIS))
+
+    def body(state, tokens):
+        master = jax.lax.with_sharding_constraint(state.master, flat_sh)
+        params = fl.unflatten(master, like=params0,
+                              dtype=(amp_dtype if amp_dtype is not None
+                                     else None))
+        params = jax.lax.with_sharding_constraint(params, param_sh)
+        loss, grads = jax.value_and_grad(lambda p: transformer_loss(
+            p, {"tokens": tokens, "targets": tokens}, run_cfg))(params)
+        flat_g = jax.lax.with_sharding_constraint(fl.flatten(grads),
+                                                  flat_sh)
+        # amp overflow-skip contract: a non-finite step never reaches
+        # the fp32 master (same select as the dp harness)
+        ok = jnp.all(jnp.isfinite(flat_g)).astype(jnp.float32)
+        new_state = opt.step_flat(state, flat_g)
+        new_state = jax.tree_util.tree_map(
+            lambda nw, old: jnp.where(ok > 0, nw, old), new_state, state)
+        return new_state, loss
+
+    step_jit = jax.jit(body, in_shardings=(state_sh, tok_sh),
+                       out_shardings=(state_sh, rep_sh))
+
+    info = {"family": plan.family, "engine": "gspmd",
+            "tp": n_tp, "dp": n_dp, "flat_world": flat_world,
+            "amp_dtype": (str(jnp.dtype(amp_dtype))
+                          if amp_dtype is not None else None)}
+    if meter:
+        tokens0 = jax.device_put(
+            jnp.zeros((global_batch, run_cfg.max_len), jnp.int32), tok_sh)
+        info["collectives"] = compiled_collectives(body, state0, tokens0)
+        info["metered"] = meter_compiled_collectives(
+            info["collectives"], "tp", MODEL_AXIS)
+
+    def step(state, tokens):
+        return step_jit(state, tokens)
+
+    return state0, step, info
+
+
+def _build_sp_step(cfg, mesh, plan, global_batch, lr, meter):
+    """The sequence-parallel engine: shard_map over (data, seq), the
+    attention core routed through ring/ulysses (``attn_override``), the
+    dp wire and zero1 update sharding riding the existing surfaces."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..models import transformer_init, transformer_loss
+    from ..optimizers import FusedAdam
+    from ..utils.pallas import has_vma, _to_varying
+    from .distributed import DistributedDataParallel
+    from .mesh import shard_map
+    from .sequence import (ring_attention, ulysses_attention, validate_sp)
+
+    n_dp = int(mesh.shape[DATA_AXIS])
+    n_sp = int(mesh.shape.get(SEQ_AXIS, 1))
+    strategy = plan.sp_strategy if plan.sp_strategy != "none" else "ring"
+    validate_sp(cfg.max_len, cfg.num_heads, n_sp, strategy)
+    if global_batch % n_dp:
+        raise ValueError(f"global batch {global_batch} must divide over "
+                         f"the data axis ({n_dp})")
+    s_local = cfg.max_len // n_sp
+
+    params0 = transformer_init(jax.random.PRNGKey(0), cfg)
+    opt = FusedAdam(lr=lr, impl="fused")
+    ddp = DistributedDataParallel(axis_name=DATA_AXIS)
+    su = ddp.weight_update(opt)
+    vma_kw = {} if has_vma() else {"check_vma": False}
+    pspec = jax.tree_util.tree_map(lambda _: P(), params0)
+
+    if strategy == "ulysses":
+        def attn(q, k, v, *, causal):
+            return ulysses_attention(q, k, v, axis_name=SEQ_AXIS,
+                                     causal=causal)
+    else:
+        def attn(q, k, v, *, causal):
+            return ring_attention(q, k, v, axis_name=SEQ_AXIS,
+                                  causal=causal)
+
+    def grads_of(params, tokens):
+        off = jax.lax.axis_index(SEQ_AXIS) * s_local
+        pv = jax.tree_util.tree_map(
+            lambda p: _to_varying(p, (DATA_AXIS, SEQ_AXIS)), params)
+        loss, grads = jax.value_and_grad(lambda p: transformer_loss(
+            p, {"tokens": tokens, "targets": tokens}, cfg,
+            attn_override=attn, pos_offset=off))(pv)
+        # fold the seq axis first: each device's grads cover only ITS
+        # sequence block's loss terms; /n_sp turns the seq sum into the
+        # seq mean, so the dp reduction below needs no extra scaling
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, SEQ_AXIS) / n_sp, grads)
+        return jax.lax.pmean(loss, (DATA_AXIS, SEQ_AXIS)), grads
+
+    if su is None:
+        state0_local = opt.init(params0)
+        sspec = jax.tree_util.tree_map(lambda _: P(), state0_local)
+
+        def body(params, state, tokens):
+            loss, grads = grads_of(params, tokens)
+            grads = ddp.allreduce_grads(grads)
+            fl = opt.flattener_for(params)
+            flat = fl.flatten(grads)
+            ok = jnp.all(jnp.isfinite(flat)).astype(jnp.float32)
+            new_state = opt.step_flat(state, flat)
+            new_state = jax.tree_util.tree_map(
+                lambda nw, old: jnp.where(ok > 0, nw, old),
+                new_state, state)
+            return (fl.unflatten(new_state.master, like=params),
+                    new_state, loss)
+    else:
+        sspec = su.state_pspecs(params0, n_dp)
+        init_s = jax.jit(shard_map(lambda p: su.init(p), mesh=mesh,
+                                   in_specs=(pspec,), out_specs=sspec))
+
+        def body(params, state, tokens):
+            loss, grads = grads_of(params, tokens)
+            params, state = su.step(state, grads, params)
+            return params, state, loss
+
+    step_sm = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, sspec, P(DATA_AXIS, SEQ_AXIS)),
+        out_specs=(pspec, sspec, P()), **vma_kw))
+    state0 = opt.init(params0) if su is None else init_s(params0)
+
+    info = {"family": plan.family, "engine": f"shard_map.sp.{strategy}",
+            "dp": n_dp, "sp": n_sp}
+    if meter:
+        from ..telemetry import events as _tel_events
+        tokens0 = jnp.zeros((global_batch, cfg.max_len), jnp.int32)
+        info["collectives"] = compiled_collectives(
+            step_sm, params0, state0, tokens0)
+        # the ring/ulysses wire lives inside the layer scan, invisible
+        # to the entry-computation walk — meter the engine's exact
+        # static schedule instead (sp.all_to_all / sp.ppermute)
+        sched = _sp_schedule_bytes(cfg, strategy, n_dp, n_sp,
+                                   global_batch)
+        info["sp_wire"] = sched
+        _tel_events.record_collective(
+            SEQ_AXIS, sched["logical_bytes"], sched["layers"], 0.0,
+            wire_bytes=sched["logical_bytes"], scheme="fp32",
+            dtype=str(jnp.dtype(cfg.dtype)), op=sched["op"],
+            family="sp")
+
+    def step(carry, tokens):
+        params, state = carry
+        params, state, loss = step_sm(params, state, tokens)
+        return (params, state), loss
+
+    return (params0, state0), step, info
+
+
+def _build_zero_step(cfg, mesh, plan, global_batch, lr, meter):
+    """The contrib-ZeRO engine: shard_map over data, the
+    DistributedFusedAdam route (permanently sharded optimizer state,
+    predivided reduce-scatter riding the plan's collective scheme via
+    the env surface Plan.apply() sets)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..contrib.optimizers import DistributedFusedAdam
+    from ..models import transformer_init, transformer_loss
+    from ..utils.pallas import has_vma, _to_varying
+    from .mesh import shard_map
+
+    n_dp = int(mesh.shape[DATA_AXIS])
+    if global_batch % n_dp:
+        raise ValueError(f"global batch {global_batch} must divide over "
+                         f"the data axis ({n_dp})")
+    params0 = transformer_init(jax.random.PRNGKey(0), cfg)
+    # impl="xla" on the sharded flat buffers (the contrib default off a
+    # tuned profile); the Pallas fused kernels need interpret mode on
+    # CPU, which the zero measurement leg must not pay for
+    opt = DistributedFusedAdam(lr=lr, shard_axis=DATA_AXIS, impl="xla")
+    pspec = jax.tree_util.tree_map(lambda _: P(), params0)
+    sspec = opt.state_pspecs()
+    vma_kw = {} if has_vma() else {"check_vma": False}
+
+    init_s = jax.jit(shard_map(lambda p: opt.init(p), mesh=mesh,
+                               in_specs=(pspec,), out_specs=sspec))
+
+    def body(params, state, tokens):
+        pv = jax.tree_util.tree_map(
+            lambda p: _to_varying(p, (DATA_AXIS,)), params)
+        loss, grads = jax.value_and_grad(lambda p: transformer_loss(
+            p, {"tokens": tokens, "targets": tokens}, cfg))(pv)
+        new_params, new_state = opt.step(state, grads, params)
+        return new_params, new_state, jax.lax.pmean(loss, DATA_AXIS)
+
+    step_sm = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(pspec, sspec, P(DATA_AXIS)),
+        out_specs=(pspec, sspec, P()), **vma_kw))
+    state0 = init_s(params0)
+
+    info = {"family": plan.family, "engine": "shard_map.zero", "dp": n_dp}
+    if meter:
+        tokens0 = jnp.zeros((global_batch, cfg.max_len), jnp.int32)
+        info["collectives"] = compiled_collectives(
+            step_sm, params0, state0, tokens0)
+
+    def step(carry, tokens):
+        params, state = carry
+        params, state, loss = step_sm(params, state, tokens)
+        return (params, state), loss
+
+    return (params0, state0), step, info

@@ -48,33 +48,97 @@ import jax
 # (the chip the r5 measurements ran on) so existing consumers are
 # unchanged; CPU gets token entries so reports/tests stay meaningful
 # (its "ici" is the host-memory shuffle an emulated mesh pays).
+#: ``dcn_bw``/``dcn_alpha_s`` are the inter-slice data-center-network
+#: tier the planner's multi-slice terms charge when a collective axis
+#: spans slices (``num_slices`` — detected from device.slice_index or
+#: pinned via the env): ~25 GB/s per host and tens-of-microseconds
+#: launch latency on current pods (public multislice figures); the CPU
+#: row keeps DCN == ICI so single-host emulation is unchanged.
 HW_CEILINGS = {
     "tpu": {"peak_flops": 197e12, "peak_bw": 819e9,
-            "ici_bw": 45e9, "ici_alpha_s": 1e-6, "hbm_bytes": 16e9},
+            "ici_bw": 45e9, "ici_alpha_s": 1e-6, "hbm_bytes": 16e9,
+            "dcn_bw": 25e9, "dcn_alpha_s": 1e-5},
     "tpu_v4": {"peak_flops": 275e12, "peak_bw": 1228e9,
-               "ici_bw": 45e9, "ici_alpha_s": 1e-6, "hbm_bytes": 32e9},
+               "ici_bw": 45e9, "ici_alpha_s": 1e-6, "hbm_bytes": 32e9,
+               "dcn_bw": 25e9, "dcn_alpha_s": 1e-5},
     "tpu_v5e": {"peak_flops": 197e12, "peak_bw": 819e9,
-                "ici_bw": 45e9, "ici_alpha_s": 1e-6, "hbm_bytes": 16e9},
+                "ici_bw": 45e9, "ici_alpha_s": 1e-6, "hbm_bytes": 16e9,
+                "dcn_bw": 25e9, "dcn_alpha_s": 1e-5},
     "tpu_v5p": {"peak_flops": 459e12, "peak_bw": 2765e9,
-                "ici_bw": 90e9, "ici_alpha_s": 1e-6, "hbm_bytes": 95e9},
+                "ici_bw": 90e9, "ici_alpha_s": 1e-6, "hbm_bytes": 95e9,
+                "dcn_bw": 25e9, "dcn_alpha_s": 1e-5},
     # CPU models the 8-device EMULATED mesh tier-1 runs on, not the
     # host's datasheet: effective bandwidth and per-collective launch
     # cost are dominated by XLA's threaded emulation (calibrated
     # against the measured flagship dp-family A/B in test_plan.py —
     # the planner's relative predictions there land within ~15%)
     "cpu": {"peak_flops": 1e11, "peak_bw": 2e10,
-            "ici_bw": 1e10, "ici_alpha_s": 5e-5, "hbm_bytes": 64e9},
+            "ici_bw": 1e10, "ici_alpha_s": 5e-5, "hbm_bytes": 64e9,
+            "dcn_bw": 1e10, "dcn_alpha_s": 5e-5},
     "gpu": {"peak_flops": 1e14, "peak_bw": 1e12,
-            "ici_bw": 300e9, "ici_alpha_s": 1e-6, "hbm_bytes": 80e9},
+            "ici_bw": 300e9, "ici_alpha_s": 1e-6, "hbm_bytes": 80e9,
+            "dcn_bw": 50e9, "dcn_alpha_s": 1e-5},
 }
 
 #: every key a ceilings row may carry (the APEX_TPU_CEILINGS grammar
 #: rejects anything else — a typo'd override must fail loudly, not
-#: silently leave the generic row in place)
+#: silently leave the generic row in place).  ``num_slices`` is
+#: topology, not silicon, but rides the same override surface so a
+#: tunnel session can pin the multislice fact the CPU-side planner
+#: can't detect.
 CEILING_KEYS = ("peak_flops", "peak_bw", "ici_bw", "ici_alpha_s",
-                "hbm_bytes")
+                "hbm_bytes", "dcn_bw", "dcn_alpha_s", "num_slices")
 
 ENV_CEILINGS = "APEX_TPU_CEILINGS"
+
+
+def calibrate_ceilings(base: dict, artifact: dict) -> dict:
+    """Fold a measured ``bench.py --plan`` artifact (``PLAN_AB_r5.json``
+    / a full bench JSON with a ``plan`` leg) into a ceilings row: the
+    leg's one-point calibration scale ``s = measured / predicted`` says
+    this machine runs ``s``x slower than the datasheet row models, so
+    every rate ceiling divides by ``s`` and every latency multiplies —
+    after which the analytic model's ABSOLUTE predictions land on the
+    measured baseline by construction, and its relative rankings carry
+    the on-chip correction.  A per-family calibration table
+    (``family_calibration``) refines the comm tier: when the dp
+    family's scale differs from the overall scale, the ratio lands on
+    the ICI/DCN terms (comm mispredicts independently of compute).
+
+    Raises ``ValueError`` when the artifact carries no measured plan
+    leg — a calibration request against an empty artifact must fail
+    loudly, not silently return the datasheet row."""
+    leg = artifact
+    for key in ("detail", "plan"):
+        if isinstance(leg, dict) and key in leg:
+            leg = leg[key]
+    if not (isinstance(leg, dict) and leg.get("leg") == "plan"
+            and isinstance(leg.get("calibration_scale"), (int, float))
+            and leg["calibration_scale"] > 0):
+        raise ValueError(
+            "ceilings calibration needs a measured plan leg with a "
+            "calibration_scale (bench.py --plan artifact); got none")
+    s = float(leg["calibration_scale"])
+    out = dict(base)
+    for k in ("peak_flops", "peak_bw", "ici_bw", "dcn_bw"):
+        if k in out:
+            out[k] = out[k] / s
+    for k in ("ici_alpha_s", "dcn_alpha_s"):
+        if k in out:
+            out[k] = out[k] * s
+    fams = leg.get("family_calibration")
+    if isinstance(fams, dict):
+        dp_s = fams.get("dp")
+        comm_fams = [v for k, v in fams.items()
+                     if k != "dp" and isinstance(v, (int, float)) and v > 0]
+        if isinstance(dp_s, (int, float)) and dp_s > 0 and comm_fams:
+            # comm tier correction: the non-dp families' extra scale
+            # relative to dp is dominated by their collective terms
+            comm_ratio = (sum(comm_fams) / len(comm_fams)) / dp_s
+            out["ici_bw"] = out["ici_bw"] / comm_ratio
+            if "dcn_bw" in out:
+                out["dcn_bw"] = out["dcn_bw"] / comm_ratio
+    return out
 
 
 def resolve_ceilings(platform: str = "cpu") -> dict:
@@ -85,16 +149,30 @@ def resolve_ceilings(platform: str = "cpu") -> dict:
         APEX_TPU_CEILINGS="v5p"                      # named generation row
         APEX_TPU_CEILINGS="peak_flops=2.75e14"       # key override
         APEX_TPU_CEILINGS="v4,ici_bw=5e10"           # row, then override
+        APEX_TPU_CEILINGS="v5e,@PLAN_AB_r5.json"     # measured calibration
 
     A bare token names an ``HW_CEILINGS`` row (``v4``/``v5e``/``v5p``
     shorthands resolve to their ``tpu_*`` rows); ``key=value`` tokens
-    override individual ceilings.  So planner/roofline predictions are
+    override individual ceilings; an ``@path`` token ingests a measured
+    ``bench.py --plan`` artifact through :func:`calibrate_ceilings` —
+    the on-chip correction loop.  So planner/roofline predictions are
     never pinned to the single generic "tpu" row: point the env at the
-    generation actually behind the tunnel."""
+    generation actually behind the tunnel, calibrated by what it
+    measured."""
     base = dict(HW_CEILINGS.get(platform, HW_CEILINGS["cpu"]))
     spec = os.environ.get(ENV_CEILINGS, "").strip()
     for tok in filter(None, (t.strip() for t in spec.split(","))):
-        if "=" in tok:
+        if tok.startswith("@"):
+            import json
+            try:
+                with open(tok[1:]) as f:
+                    art = json.load(f)
+            except (OSError, ValueError) as e:
+                raise ValueError(
+                    f"{ENV_CEILINGS}: cannot read calibration artifact "
+                    f"{tok[1:]!r}: {e}") from None
+            base = calibrate_ceilings(base, art)
+        elif "=" in tok:
             key, _, val = tok.partition("=")
             key = key.strip()
             if key not in CEILING_KEYS:

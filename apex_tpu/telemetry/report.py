@@ -97,8 +97,12 @@ def summarize(records: List[dict]) -> dict:
     # selected collective scheme actually shipped (docs/telemetry.md) —
     # absent compressed counters (pre-compression JSONLs) degrade to
     # wire == logical
+    # ... plus the SPMD engine's model-parallel families (tp.psum from
+    # the compiled-HLO meter, sp.all_to_all/sp.ppermute from the
+    # sequence-parallel collectives — parallel.spmd)
     _coll_ops = ("ddp.allreduce", "zero.reduce_scatter", "zero.allgather",
-                 "ddp.reduce_scatter", "ddp.param_allgather")
+                 "ddp.reduce_scatter", "ddp.param_allgather",
+                 "tp.psum", "sp.all_to_all", "sp.ppermute")
     coll_logical = sum(counter_final(f"{n}_bytes") for n in _coll_ops)
     coll_wire = sum(counter_final(f"{n}_compressed_bytes")
                     for n in _coll_ops) or coll_logical

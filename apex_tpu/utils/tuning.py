@@ -102,6 +102,10 @@ SCHEMA = {
     "plan_update_sharding": lambda v: v in ("off", "zero1"),
     "plan_collective_scheme": lambda v: v in ("fp32", "bf16",
                                               "int8_blockscale"),
+    # the winner's param-allgather wire (update-sharded plans; fp32
+    # unless the measured winner explicitly quantized its gather)
+    "plan_allgather_scheme": lambda v: v in ("fp32", "bf16",
+                                             "int8_blockscale"),
 }
 
 

@@ -882,21 +882,42 @@ def bench_update_sharding(on_tpu):
 
 
 def bench_plan(on_tpu, top_k=3, steps=5):
-    """Auto-parallel planner verify leg (ISSUE 10): run the cost-model
-    search over the flagship transformer at the ambient chip count,
-    then MEASURE the top-k predicted plans (plus the all-defaults
-    baseline) through the real DDP training step each plan's
-    ``apply()`` configures.  A one-point calibration on the baseline
-    (scale = measured / predicted) turns the analytic predictions into
-    absolute ms, and the leg reports the calibration error of the
-    first-ranked measurable plan — the number the
-    ``apply_perf_results`` drift guard audits (>25% disagreement means
+    """Auto-parallel planner verify leg (ISSUE 10/12): run the
+    cost-model search over the flagship transformer at the ambient chip
+    count, then MEASURE the top-k predicted plans (plus the all-defaults
+    baseline) through the real step each plan's ``apply()`` configures —
+    since the ``parallel.spmd`` engine every family is runnable, so the
+    measured set is topped up with the best-ranked tp and sp candidates
+    when the top-k misses them (the acceptance surface: >= 1 tp>1 and
+    >= 1 sp>1 plan measured alongside the dp family).  The RANKING uses
+    the production enumeration (``SP_MIN_SEQ`` floor and all) — when
+    the profile's sequence is too short for any production sp plan (the
+    CPU stand-in's seq 64), sp representatives are enumerated
+    separately at the profile's own length as COVERAGE rows: engine
+    evidence, never ranking (the cost model ranks sp only where sp
+    makes sense).
+
+    Calibration is ONE-POINT PER FAMILY: the all-defaults baseline
+    calibrates the dp family (and the global ``calibration_scale``),
+    and each other family's first measured row anchors its own scale —
+    each row reports ``family_calibration_error_pct`` against its
+    family's anchor.  Anchors read 0 by construction, which is why
+    coverage tops up TWO rows per model-parallel family where the space
+    allows: the second row is the one the ``plan_violations`` audit
+    actually checks.  The headline ``calibration_error_pct`` is the
+    ranked pick vs ITS FAMILY's calibration — for a dp-family pick
+    that is exactly the seed contract (baseline-anchored scale), and
+    cross-family it never conflates a family's systematic engine-stack
+    offset (e.g. the GSPMD tp step swaps the interpret-mode Pallas
+    kernels for XLA paths on CPU) with genuine model drift (>25% means
     the model can no longer be trusted to pick winners).  The measured
     winner's knob dict is what ``decide()`` persists as ``plan_*``
     tuning keys."""
     import numpy as np
     from apex_tpu import telemetry
     from apex_tpu.parallel import plan as planmod
+    from apex_tpu.parallel import spmd as spmdmod
+    from apex_tpu.telemetry import events as tel_events
     from apex_tpu.telemetry import report as treport
 
     n_dev = len(jax.devices())
@@ -909,7 +930,30 @@ def bench_plan(on_tpu, top_k=3, steps=5):
 
     baseline = planmod.predict(prof, planmod.default_plan(n_dev),
                                platform=platform)
-    cand = [p for p in ranked if p.measurable][:top_k]
+    cand = list(ranked[:top_k])
+    # family coverage: the engine runs everything, so the artifact must
+    # carry measured evidence for the model-parallel families too — TWO
+    # rows per family where the space allows (the first anchors the
+    # family's one-point calibration, the second is the row the
+    # plan_violations audit actually checks).  sp plans below the
+    # production SP_MIN_SEQ floor come from a separate enumeration at
+    # the profile's own sequence length (coverage, never ranking).
+    pool = list(ranked)
+    if not any(p.family == "sp" for p in pool):
+        sp_pool = [p for p in planmod.enumerate_plans(
+                       prof, n_dev, platform=platform,
+                       sp_min_seq=min(planmod.SP_MIN_SEQ, prof.seq))
+                   if p.family == "sp" and p.feasible]
+        sp_pool.sort(key=lambda p: p.predicted_step_ms)
+        pool += sp_pool
+    for fam in ("tp", "sp"):
+        have = sum(p.family == fam for p in cand)
+        for rep in (p for p in pool if p.family == fam):
+            if have >= 2:
+                break
+            if not any(rep.knobs() == c.knobs() for c in cand):
+                cand.append(rep)
+                have += 1
     if not any(p.knobs() == baseline.knobs() for p in cand):
         cand.append(baseline)
 
@@ -922,50 +966,85 @@ def bench_plan(on_tpu, top_k=3, steps=5):
     tokens = jnp.asarray(rng.randint(
         0, cfg.vocab_size, (gb, cfg.max_len)).astype("int32"))
 
-    rows = []
-    for p in cand:
-        _log(f"plan leg: measuring [{p.describe() or 'all-defaults'}] ...")
-        with p.apply() as mesh:
-            carry, step = planmod.build_flagship_step(
-                cfg, mesh, global_batch=gb)
-            t0 = time.perf_counter()
-            carry, loss = step(carry, tokens)       # compile + first run
-            _sync(loss)
-            compile_ms = (time.perf_counter() - t0) * 1e3
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                carry, loss = step(carry, tokens)
-            _sync(loss)
-            ms = (time.perf_counter() - t0) / steps * 1e3
-        h.observe(ms)
-        rows.append({"knobs": p.knobs(),
-                     "plan": p.describe() or "all-defaults",
-                     "predicted_ms_raw": round(p.predicted_step_ms, 4),
-                     "hbm_bytes": p.predicted_hbm_bytes,
-                     "measured_ms": round(ms, 3),
-                     "compile_ms": round(compile_ms, 1),
-                     "loss": float(loss)})
-        del carry, step
-        gc.collect()
+    # measurement ORDER: baseline first, then cand order — the global
+    # calibration anchor and the ranked pick run back-to-back, so the
+    # process-warmup drift an emulated mesh accumulates over the leg
+    # (allocator growth, cache warmth) lands in neither the headline
+    # error nor the pick-vs-baseline comparison.  The artifact's row
+    # order stays cand order (rows[0] IS the ranked pick — the
+    # plan_violations contract).
+    order = sorted(cand, key=lambda p: p.knobs() != baseline.knobs())
+    measured = {}
+    prev = tel_events.set_default(reg)
+    try:
+        for p in order:
+            _log(f"plan leg: measuring [{p.describe() or 'all-defaults'}]"
+                 " ...")
+            with p.apply() as mesh:
+                carry, step, info = spmdmod.build_plan_step(
+                    cfg, mesh, p, global_batch=gb)
+                t0 = time.perf_counter()
+                carry, loss = step(carry, tokens)   # compile + first run
+                _sync(loss)
+                compile_ms = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    carry, loss = step(carry, tokens)
+                _sync(loss)
+                ms = (time.perf_counter() - t0) / steps * 1e3
+            h.observe(ms)
+            measured[cand.index(p)] = {
+                "knobs": p.knobs(),
+                "plan": p.describe() or "all-defaults",
+                "family": p.family,
+                "engine": info.get("engine"),
+                "predicted_ms_raw": round(p.predicted_step_ms, 4),
+                "hbm_bytes": p.predicted_hbm_bytes,
+                "measured_ms": round(ms, 3),
+                "compile_ms": round(compile_ms, 1),
+                "loss": float(loss),
+                "collectives": info.get("collectives")}
+            del carry, step
+            gc.collect()
+    finally:
+        tel_events.set_default(prev)
+    rows = [measured[i] for i in range(len(cand))]
 
     base_row = next(r for r in rows
                     if r["knobs"] == baseline.knobs())
     scale = (base_row["measured_ms"] / base_row["predicted_ms_raw"]
              if base_row["predicted_ms_raw"] else 1.0)
+    # one-point calibration per family: dp anchors on the baseline; the
+    # first measured row of every other family anchors its own scale
+    fam_scale = {"dp": scale}
+    for row in rows:
+        if row["predicted_ms_raw"]:
+            fam_scale.setdefault(
+                row["family"], row["measured_ms"] / row["predicted_ms_raw"])
     for row in rows:
         row["predicted_ms"] = round(row["predicted_ms_raw"] * scale, 3)
+        fs = fam_scale.get(row["family"], scale)
+        fam_pred = row["predicted_ms_raw"] * fs
+        row["family_predicted_ms"] = round(fam_pred, 3)
+        row["family_calibration_error_pct"] = round(
+            (abs(row["measured_ms"] - fam_pred) / row["measured_ms"]
+             * 100.0) if row["measured_ms"] else 0.0, 2)
 
-    # the first measurable candidate IS the plan search would ship —
-    # its calibration error is the leg's headline evidence
+    # the first candidate IS the plan the search would ship — its
+    # calibration error (vs ITS family's one-point scale; for a
+    # dp-family pick that is the baseline-anchored seed contract) is
+    # the leg's headline evidence
     top = rows[0]
-    err_pct = (abs(top["measured_ms"] - top["predicted_ms"])
-               / top["measured_ms"] * 100.0) if top["measured_ms"] else 0.0
+    err_pct = top["family_calibration_error_pct"]
     win = min(rows, key=lambda r: r["measured_ms"])
     out = {
         "leg": "plan", "chips": n_dev, "model": prof.name,
         "global_batch": gb,
         "candidates_enumerated": n_all, "feasible": len(ranked),
         "plans": rows,
+        "families_measured": sorted({r["family"] for r in rows}),
+        "family_calibration": {k: round(v, 4)
+                               for k, v in fam_scale.items()},
         "predicted_winner": ranked[0].knobs() if ranked else None,
         "predicted_winner_measurable": bool(ranked and
                                             ranked[0].measurable),
@@ -981,7 +1060,96 @@ def bench_plan(on_tpu, top_k=3, steps=5):
     _log(f"plan leg: predicted [{top['plan']}] {top['predicted_ms']} ms "
          f"vs measured {top['measured_ms']} ms "
          f"(calibration error {out['calibration_error_pct']}%), "
-         f"measured winner [{win['plan']}]")
+         f"measured winner [{win['plan']}], families "
+         f"{out['families_measured']}")
+    reg.flush()
+    out["telemetry"] = {"records": sink.records,
+                        "summary": treport.summarize(sink.records)}
+    return out
+
+
+def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
+    """SPMD step-engine A/B (ISSUE 12, watcher stage 2e): one
+    representative plan per engine family — dp x tp (GSPMD), dp x sp
+    ring, dp x sp ulysses, zero1 update sharding, contrib ZeRO —
+    trained a few steps against the dp baseline on the same batch.
+    Evidence per family: step ms, final-loss relative error vs the
+    baseline (the engines are fp32-tolerance-equivalent by
+    construction), and the compiled-HLO collective sub-table, with the
+    ``tp.psum`` / ``sp.all_to_all`` meter families embedded in the
+    telemetry block so the comm model's per-device payloads can be
+    validated against what the compiled program actually exchanges."""
+    import numpy as np
+    from apex_tpu import telemetry
+    from apex_tpu.parallel import plan as planmod
+    from apex_tpu.parallel import spmd as spmdmod
+    from apex_tpu.telemetry import events as tel_events
+    from apex_tpu.telemetry import report as treport
+
+    n_dev = len(jax.devices())
+    if cfg is None:
+        cfg = planmod._flagship_cfg(on_tpu)
+    gb = global_batch or (32 if on_tpu else 8)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (gb, cfg.max_len)).astype("int32"))
+
+    plans = [("dp_baseline", planmod.Plan(dp=n_dev))]
+    if n_dev % 2 == 0 and cfg.num_heads % 2 == 0:
+        plans.append(("dp_tp", planmod.Plan(dp=n_dev // 2, tp=2)))
+        if cfg.max_len % 2 == 0:
+            plans.append(("dp_sp_ring", planmod.Plan(
+                dp=n_dev // 2, sp=2, sp_strategy="ring")))
+            plans.append(("dp_sp_ulysses", planmod.Plan(
+                dp=n_dev // 2, sp=2, sp_strategy="ulysses")))
+        plans.append(("zero1", planmod.Plan(dp=n_dev,
+                                            update_sharding="zero1")))
+        plans.append(("zero", planmod.Plan(dp=n_dev, zero=True)))
+
+    sink = telemetry.MemorySink()
+    reg = telemetry.Registry(sink=sink, flush_interval=0,
+                             rank0_only=False, run_id="bench",
+                             memory=False)
+    h = reg.histogram("step_time_ms")
+    out = {"leg": "spmd", "chips": n_dev, "global_batch": gb,
+           "families": {}}
+    base_loss = None
+    prev = tel_events.set_default(reg)
+    try:
+        for name, p in plans:
+            _log(f"spmd leg: {name} [{p.describe() or 'all-defaults'}] ...")
+            with p.apply() as mesh:
+                carry, step, info = spmdmod.build_plan_step(
+                    cfg, mesh, p, global_batch=gb)
+                t0 = time.perf_counter()
+                carry, loss = step(carry, tokens)
+                _sync(loss)
+                compile_ms = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    carry, loss = step(carry, tokens)
+                _sync(loss)
+                ms = (time.perf_counter() - t0) / steps * 1e3
+            loss = float(loss)
+            if name == "dp_baseline":
+                base_loss = loss
+            h.observe(ms)
+            rec = {"plan": p.describe() or "all-defaults",
+                   "family": p.family, "engine": info.get("engine"),
+                   "step_ms": round(ms, 3),
+                   "compile_ms": round(compile_ms, 1),
+                   "loss": loss}
+            if base_loss:
+                rec["loss_rel_err_vs_baseline"] = round(
+                    abs(loss - base_loss) / abs(base_loss), 6)
+            if info.get("collectives"):
+                rec["collectives"] = info["collectives"]
+            out["families"][name] = rec
+            reg.gauge(f"spmd.{name}.step_ms").set(ms)
+            del carry, step
+            gc.collect()
+    finally:
+        tel_events.set_default(prev)
     reg.flush()
     out["telemetry"] = {"records": sink.records,
                         "summary": treport.summarize(sink.records)}
@@ -1169,6 +1337,18 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
         flush("plan", detail["plan"])
     else:
         _log("skipping plan leg (budget)")
+    gc.collect()
+    # SPMD step-engine A/B (ISSUE 12): one representative plan per
+    # family vs the dp baseline, compiled collective sub-table embedded
+    if budget_left() > 60:
+        try:
+            with _leg_span("spmd"):
+                detail["spmd"] = bench_spmd(on_tpu)
+        except Exception as err:
+            detail["spmd"] = {"error": repr(err)[:200]}
+        flush("spmd", detail["spmd"])
+    else:
+        _log("skipping spmd leg (budget)")
     gc.collect()
     # max-throughput BERT rung ladder (TPU only — the CPU stand-in says
     # nothing about the remat trade)
@@ -1361,6 +1541,19 @@ def _plan_main():
                       "plan": bench_plan(on_tpu)}))
 
 
+def _spmd_main():
+    """``python bench.py --spmd``: ONLY the SPMD step-engine family A/B
+    on the ambient backend, one JSON line — the leg tpu_watch.sh runs
+    as its own stage 2e (a per-family A/B fits a short tunnel window
+    the full bench would waste)."""
+    from apex_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+    on_tpu = jax.default_backend() == "tpu"
+    print(json.dumps({"metric": "spmd_ab",
+                      "backend": jax.default_backend(),
+                      "spmd": bench_spmd(on_tpu)}))
+
+
 if __name__ == "__main__":
     if "--collectives" in sys.argv:
         _collectives_main()
@@ -1368,6 +1561,8 @@ if __name__ == "__main__":
         _update_sharding_main()
     elif "--plan" in sys.argv:
         _plan_main()
+    elif "--spmd" in sys.argv:
+        _spmd_main()
     elif "--inner" in sys.argv:
         _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:

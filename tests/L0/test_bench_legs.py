@@ -381,6 +381,10 @@ def _stub_timings(bench, monkeypatch, wedge_at=None):
                             "opt_state_shrink": 7.9,
                             "modes": {"off": {"step_ms": 2.0},
                                       "zero1": {"step_ms": 1.5}}}))
+    monkeypatch.setattr(bench, "bench_spmd",
+                        mk("bench_spmd",
+                           {"leg": "spmd", "chips": 8,
+                            "families": {"dp_tp": {"step_ms": 2.0}}}))
     monkeypatch.setattr(bench, "bench_plan",
                         mk("bench_plan",
                            {"leg": "plan", "chips": 8,
@@ -428,10 +432,11 @@ def test_run_bench_full_flush_sequence(tmp_path, monkeypatch):
     rn50_key = ("rn50" if jax.default_backend() == "tpu"
                 else "rn50_cpu_standin_resnet18")
     assert set(legs) == {"headline", rn50_key, "bert_e2e", "collectives",
-                         "update_sharding", "plan"}
+                         "update_sharding", "plan", "spmd"}
     assert legs["collectives"]["data"]["leg"] == "collectives"
     assert legs["update_sharding"]["data"]["leg"] == "update_sharding"
     assert legs["plan"]["data"]["leg"] == "plan"
+    assert legs["spmd"]["data"]["leg"] == "spmd"
     assert legs["headline"]["data"]["complete"] is True
     assert legs["headline"]["data"]["winner"] == "fused_flat"
     assert payload["value"] == 19.0

@@ -285,6 +285,9 @@ def test_per_leaf_callable_routing(mesh):
     assert vals["ddp.allreduce_compressed_bytes"] == wire
 
 
+@pytest.mark.slow   # ~21s: a 12-round constant-grad A/B; the int8+EF
+# training path stays in tier-1 via test_ab_flagship_transformer_int8_
+# within_tolerance (ISSUE 12 budget reclaim)
 def test_error_feedback_tightens_vs_naive(mesh):
     """With a CONSTANT gradient, naive quantization repeats the same
     bias every step; error feedback carries the residual so the running

@@ -185,9 +185,14 @@ def test_resolve_ceilings_generations_and_env(monkeypatch):
     documented APEX_TPU_CEILINGS override, so planner/roofline
     predictions aren't pinned to the single generic "tpu" row."""
     monkeypatch.delenv(prof.ENV_CEILINGS, raising=False)
-    # every row carries the full key set (the planner reads all of them)
+    # every row carries the full silicon key set (the planner reads all
+    # of them); num_slices is topology, override-only — a row carrying
+    # it would defeat plan.search()'s live-mesh detection (ISSUE 12)
     for name, row in prof.HW_CEILINGS.items():
-        assert set(row) == set(prof.CEILING_KEYS), name
+        assert set(row) == set(prof.CEILING_KEYS) - {"num_slices"}, name
+    monkeypatch.setenv(prof.ENV_CEILINGS, "num_slices=2")
+    assert prof.resolve_ceilings("tpu")["num_slices"] == 2
+    monkeypatch.delenv(prof.ENV_CEILINGS)
     # the generic tpu row stays the v5e chip the r5 runs measured on
     assert prof.HW_CEILINGS["tpu"] == prof.HW_CEILINGS["tpu_v5e"]
     assert prof.resolve_ceilings("tpu") == prof.HW_CEILINGS["tpu"]

@@ -47,6 +47,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_US_CMD": "",
            # and the auto-parallel plan A/B (stage 2d)
            "APEX_WATCH_PLAN_CMD": "",
+           # and the SPMD engine family A/B (stage 2e)
+           "APEX_WATCH_SPMD_CMD": "",
            # and the elastic kill-N-resume-M proof (stage 3b)
            "APEX_WATCH_ELASTIC_CMD": "",
            "PYTHONPATH": ROOT,
@@ -504,6 +506,51 @@ def test_plan_ab_stage_artifact_and_span(tmp_path):
     assert "plan A/B done rc=1" in log3
     assert not (tmp_path / "PLAN_FAIL.json").exists()
     assert not (tmp_path / "PLAN_FAIL.json.run").exists()
+
+
+def test_spmd_ab_stage_artifact_and_span(tmp_path):
+    """ISSUE 12 satellite: the SPMD engine family A/B runs as watch
+    stage 2e — artifact written atomically, span appended to the
+    streaming timeline, skip-when-complete, and a failing leg leaves no
+    truncated artifact behind (mirror of stages 2b-2d)."""
+    fake = json.dumps({"metric": "spmd_ab", "backend": "tpu",
+                       "spmd": {"leg": "spmd", "families": {}}})
+    marker = tmp_path / "spmd_calls"
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    }
+    r, log = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_SPMD_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    art = json.loads((tmp_path / "SPMD_AB_r5.json").read_text())
+    assert art["spmd"]["leg"] == "spmd"
+    assert "spmd A/B done rc=0" in log
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.spmd_ab" in names
+    # second window: artifact present -> stage skipped
+    r2, _ = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_SPMD_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+
+    # a failing A/B leaves no truncated artifact behind
+    r3, log3 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_SPMD_JSON": "SPMD_FAIL.json",
+        "APEX_WATCH_SPMD_CMD": "echo '{\"partial\":true'; false",
+    })
+    assert r3.returncode == 0
+    assert "spmd A/B done rc=1" in log3
+    assert not (tmp_path / "SPMD_FAIL.json").exists()
+    assert not (tmp_path / "SPMD_FAIL.json.run").exists()
 
 
 def test_elastic_stage_artifact_and_span(tmp_path):

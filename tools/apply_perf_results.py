@@ -331,8 +331,19 @@ def plan_violations(artifact) -> list:
                            "plans (need the ranked pick AND the "
                            "baseline)")
             else:
+                # drift = the ranked pick losing to a SAME-FAMILY row
+                # by >25%: within a family the calibration is one-point
+                # so a mis-ranking is the model's fault.  Cross-family
+                # gaps carry each engine's systematic stack offset
+                # (ISSUE 12 — e.g. the GSPMD tp step swaps interpret-
+                # mode Pallas kernels for XLA paths on CPU) and are
+                # audited via family_calibration_error_pct instead.
+                # Rows without a family key (pre-ISSUE-12 artifacts)
+                # all read None and keep the old whole-table check.
                 top_ms = rows[0]["measured_ms"]
-                best_ms = min(r["measured_ms"] for r in rows)
+                fam0 = rows[0].get("family")
+                best_ms = min(r["measured_ms"] for r in rows
+                              if r.get("family") == fam0)
                 if best_ms and top_ms > 1.25 * best_ms:
                     out.append(
                         f"{path}: calibration drift — predicted pick "
@@ -344,6 +355,29 @@ def plan_violations(artifact) -> list:
                            "calibration_error_pct")
             elif err > 25.0:
                 out.append(f"{path}: calibration error {err}% > 25%")
+            # ISSUE 12: tp/sp/zero winners must be MEASUREMENT-backed —
+            # a winner field claiming an engine family with no measured
+            # row carrying those exact knobs is a prediction-only
+            # winner, which decide() must never persist
+            win = node.get("measured_winner")
+            if isinstance(win, dict) and (
+                    win.get("tp", 1) > 1 or win.get("sp", 1) > 1
+                    or win.get("zero")):
+                if not any(r.get("knobs") == win for r in rows):
+                    out.append(
+                        f"{path}: measured_winner engages "
+                        "tp/sp/zero but no measured row carries those "
+                        "knobs — prediction-only winner")
+            # the per-family one-point calibration must hold for the
+            # model-parallel families the engine measured (anchors read
+            # 0 by construction; non-anchor rows are the real check)
+            for r in rows:
+                ferr = r.get("family_calibration_error_pct")
+                if r.get("family") in ("tp", "sp") and \
+                        isinstance(ferr, (int, float)) and ferr > 25.0:
+                    out.append(
+                        f"{path}: {r.get('plan')} family calibration "
+                        f"error {ferr}% > 25%")
             if not isinstance(node.get("telemetry"), dict):
                 out.append(f"{path}: plan leg embeds no telemetry")
         for k, v in node.items():
@@ -640,6 +674,13 @@ def decide(bench, kern):
                     and not plan_violations({"plan": pl}):
                 win = min(mrows, key=lambda r: r["measured_ms"])
                 kn = win["knobs"]
+                # ISSUE 12 gate: a tp>1 / sp>1 / zero winner may only
+                # persist with a MEASURED row behind it.  ``win`` comes
+                # from mrows so this holds by construction — the assert
+                # keeps a future refactor (e.g. electing the predicted
+                # ranking) from silently shipping prediction-only
+                # engine-family winners.
+                assert any(r["knobs"] == kn for r in mrows)
                 if win["measured_ms"] <= base_ms:
                     prof["plan_dp"] = int(kn.get("dp", 1))
                     prof["plan_tp"] = int(kn.get("tp", 1))
@@ -651,6 +692,8 @@ def decide(bench, kern):
                         "update_sharding", "off")
                     prof["plan_collective_scheme"] = kn.get(
                         "collective_scheme", "fp32")
+                    prof["plan_allgather_scheme"] = kn.get(
+                        "allgather_scheme", "fp32")
                     rows.append((
                         "plan_* (auto-parallel)",
                         win.get("plan", "winner"),

@@ -163,6 +163,55 @@ def check_vmem_budget(tiny):
     return worst
 
 
+def check_spmd_compile(tiny):
+    """SPMD step-engine compile smoke (ISSUE 12): every plan family —
+    dp x tp (GSPMD jit), dp x sp ring, dp x sp ulysses, zero1 update
+    sharding, contrib ZeRO — builds and runs one tiny train step on a
+    2x2 mesh (4 devices; smaller device counts degrade to the
+    factorizations that fit).  Value is the count of families that
+    failed to build/run (0.0 = all compiled); a toolchain where a
+    family's engine cannot even compile must fail the smoke before a
+    capture window is spent measuring it.  The tiny and production
+    variants run the same logic — the engine's cost is compile time,
+    not shape-dependent numerics."""
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.models import TransformerConfig
+    from apex_tpu.parallel import plan as pm
+    from apex_tpu.parallel import spmd
+
+    n = len(jax.devices())
+    cfg = TransformerConfig(vocab_size=64, max_len=16, num_layers=1,
+                            d_model=32, num_heads=2, d_ff=64,
+                            xent_impl="xla")
+    gb = 4
+    plans = []
+    if n >= 4:
+        plans += [pm.Plan(dp=2, tp=2),
+                  pm.Plan(dp=2, sp=2, sp_strategy="ring"),
+                  pm.Plan(dp=2, sp=2, sp_strategy="ulysses"),
+                  pm.Plan(dp=4, update_sharding="zero1"),
+                  pm.Plan(dp=4, zero=True)]
+    elif n >= 2:
+        plans += [pm.Plan(dp=2, update_sharding="zero1"),
+                  pm.Plan(dp=2, zero=True)]
+    else:            # single chip: the dp engine is the only family
+        plans += [pm.Plan(dp=1)]
+    failed = 0
+    toks = jnp.zeros((gb, cfg.max_len), jnp.int32)
+    for p in plans:
+        try:
+            with p.apply(jax.devices()[: p.chips]) as mesh:
+                carry, step, _info = spmd.build_plan_step(
+                    cfg, mesh, p, global_batch=gb, meter=False)
+                _, loss = step(carry, toks)
+                if not bool(jnp.isfinite(loss)):
+                    failed += 1
+        except Exception:
+            failed += 1
+    return float(failed)
+
+
 def check_multi_tensor(tiny):
     import jax.numpy as jnp
     import numpy as np
@@ -197,6 +246,10 @@ CHECKS = {
     # not a numerics check: the value is the worst used/budget VMEM
     # ratio over the flash kernel variants — 1.0 is the budget line
     "vmem_budget": (check_vmem_budget, 1.0),
+    # not a numerics check: the value is the count of SPMD plan
+    # families that failed to compile+run a tiny step — 0 required
+    # (tol 0.5 admits only the zero count)
+    "spmd_compile": (check_spmd_compile, 0.5),
 }
 
 

@@ -118,6 +118,15 @@ US_TO=${APEX_WATCH_US_TO:-300}
 PLAN_CMD=${APEX_WATCH_PLAN_CMD-"python bench.py --plan"}
 PLAN_JSON=${APEX_WATCH_PLAN_JSON:-PLAN_AB_r5.json}
 PLAN_TO=${APEX_WATCH_PLAN_TO:-400}
+# stage 2e: SPMD step-engine family A/B (ISSUE 12) — one representative
+# plan per engine family (dp x tp GSPMD, dp x sp ring/ulysses, zero1,
+# contrib ZeRO) vs the dp baseline, with the compiled-HLO collective
+# sub-table + tp.psum/sp.all_to_all meters embedded; the on-chip proof
+# that every planner family actually RUNS.  ${VAR-default}: an
+# explicitly EMPTY override disables it
+SPMD_CMD=${APEX_WATCH_SPMD_CMD-"python bench.py --spmd"}
+SPMD_JSON=${APEX_WATCH_SPMD_JSON:-SPMD_AB_r5.json}
+SPMD_TO=${APEX_WATCH_SPMD_TO:-400}
 INTEROP_CMD=${APEX_WATCH_INTEROP_CMD:-"python tools/bench_interop.py"}
 INTEROP_JSON=${APEX_WATCH_INTEROP_JSON:-INTEROP_r5.json}
 INTEROP_TO=${APEX_WATCH_INTEROP_TO:-600}
@@ -296,6 +305,21 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$PLAN_JSON".run
       fi
       echo "$(date +%H:%M:%S) plan A/B done rc=$rcp" >> "$LOG"
+    fi
+    # ---- stage 2e: SPMD engine family A/B (best-effort, short) ----
+    if [ -n "$SPMD_CMD" ] && [ ! -s "$SPMD_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$SPMD_TO" bash -c "$SPMD_CMD" > "$SPMD_JSON".run 2>> "$LOG"
+      rcs=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span spmd_ab "$t0" "$rcs"
+      stage_mem
+      if [ $rcs -eq 0 ] && [ -s "$SPMD_JSON".run ]; then
+        mv "$SPMD_JSON".run "$SPMD_JSON"
+      else
+        # a wedged/failed A/B never leaves a truncated artifact behind
+        rm -f "$SPMD_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) spmd A/B done rc=$rcs" >> "$LOG"
     fi
     # ---- stage 3a: guard-driven resumable train (incremental) ----
     # BEFORE the all-or-nothing save/resume leg: the guard leg makes
