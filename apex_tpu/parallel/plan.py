@@ -76,6 +76,7 @@ from . import weight_update as _wu
 __all__ = [
     "ModelProfile", "Plan", "profile_step", "flagship_profile",
     "collective_time_s", "compute_time_s", "predict", "plan_hbm_bytes",
+    "resolve_overlap_fraction", "ENV_OVERLAP",
     "enumerate_plans", "search", "default_plan", "from_tuning",
     "set_replan_hook", "get_replan_hook",
     "build_flagship_step", "format_plans", "PLAN_SCHEMES", "TUNING_KEYS",
@@ -107,6 +108,31 @@ DEFAULT_TIE_TOL = 0.03
 #: sequence-parallel candidates only make sense for long sequences —
 #: below this the per-layer exchange dominates any activation saving
 SP_MIN_SEQ = 2048
+
+#: env override for the comm model's overlap factor (the measured
+#: exposed-comm fraction) — precedence: explicit ``predict`` arg > this
+#: env pin > the ``overlap_measured_fraction`` tuning key > 1.0 (fully
+#: synchronous collectives, today's engine reality)
+ENV_OVERLAP = "APEX_TPU_OVERLAP_FRACTION"
+
+
+def resolve_overlap_fraction(explicit: Optional[float] = None) -> float:
+    """The dp-comm overlap factor: the fraction of modeled collective
+    time the step actually EXPOSES (``telemetry.timeline``'s measured
+    ``exposed_comm_fraction``, persisted by ``apply_perf_results`` as
+    the ``overlap_measured_fraction`` tuning key).  Clamped to [0, 1];
+    without any measurement the model keeps charging the full wire
+    time — exactly the synchronous engine it describes."""
+    if explicit is None:
+        env = os.environ.get(ENV_OVERLAP)
+        if env:
+            explicit = float(env)
+        else:
+            from ..utils import tuning
+            v = tuning.get("overlap_measured_fraction")
+            explicit = v if isinstance(v, (int, float)) \
+                and not isinstance(v, bool) else 1.0
+    return min(max(float(explicit), 0.0), 1.0)
 
 
 # ---------------------------------------------------------------------------
@@ -539,11 +565,22 @@ def plan_hbm_bytes(profile: ModelProfile, plan: Plan) -> Tuple[int, dict]:
 
 
 def predict(profile: ModelProfile, plan: Plan, ceilings=None,
-            platform: Optional[str] = None) -> Plan:
+            platform: Optional[str] = None,
+            overlap_fraction: Optional[float] = None) -> Plan:
     """Fill ``plan``'s predicted step time (with per-component
     breakdown), HBM bytes, and feasibility against the ceilings'
-    capacity.  Returns the same plan, mutated."""
+    capacity.  Returns the same plan, mutated.
+
+    ``overlap_fraction`` is the comm model's overlap factor (exposed
+    dp comm = modeled comm x fraction; see
+    :func:`resolve_overlap_fraction` for the default chain) — the step
+    is charged only the EXPOSED part of the dp gradient exchange, so a
+    measured overlap changes where compression pays: int8's codec cost
+    only wins when the wire time it saves was exposed.  The raw
+    modeled comm stays visible in ``breakdown["dp_comm_ms"]``;
+    ``breakdown["dp_comm_exposed_ms"]`` is what the total charges."""
     ceil = _resolve_ceil(ceilings, platform or profile.platform)
+    overlap = resolve_overlap_fraction(overlap_fraction)
     dp, tp, sp = plan.dp, plan.tp, plan.sp
     shards = dp * tp * sp
 
@@ -598,14 +635,22 @@ def predict(profile: ModelProfile, plan: Plan, ceilings=None,
             t_sp = 2 * max(profile.layers, 1) * collective_time_s(
                 "all_gather", 2 * act / sp, sp, ceil)
 
-    total_s = t_train + t_update + t_dp + t_tp + t_sp
+    # only the dp wire is overlap-eligible: its collectives are the
+    # ones the backward can hide (bucket-by-bucket as grads become
+    # ready); tp/sp exchanges sit ON the critical path between layer
+    # ops, so they stay fully charged
+    t_dp_exposed = t_dp * overlap
+    total_s = t_train + t_update + t_dp_exposed + t_tp + t_sp
     hbm, by = plan_hbm_bytes(profile, plan)
     plan.predicted_step_ms = total_s * 1e3
     plan.predicted_hbm_bytes = int(hbm)
     plan.hbm_by_class = by
     plan.breakdown = {
         "train_ms": t_train * 1e3, "update_ms": t_update * 1e3,
-        "dp_comm_ms": t_dp * 1e3, "tp_comm_ms": t_tp * 1e3,
+        "dp_comm_ms": t_dp * 1e3,
+        "dp_comm_exposed_ms": t_dp_exposed * 1e3,
+        "overlap_fraction": overlap,
+        "tp_comm_ms": t_tp * 1e3,
         "sp_comm_ms": t_sp * 1e3,
     }
     plan.feasible = hbm <= ceil["hbm_bytes"]

@@ -52,11 +52,24 @@ def _latest_trace_file(logdir: str) -> str:
     return max(hits, key=os.path.getmtime)
 
 
-def events_from_chrome(raw: list) -> list[dict[str, Any]]:
+class EventList(list):
+    """Parsed-event list + the ``dropped_events`` count: complete
+    events a truncated capture left without ``ts``/``dur`` (a profiler
+    killed mid-flush writes torn records).  Mirrors the Tracer export's
+    ``droppedSpans`` convention — loss is counted, never silent, so a
+    suspiciously thin capture is detectable."""
+
+    dropped_events: int = 0
+
+
+def events_from_chrome(raw: list) -> EventList:
     """Complete-span ("X") events from a raw Chrome traceEvents list,
     each annotated with its process/thread display names (from the "M"
     metadata events).  Shared by this module's profiler-dir loader and
-    ``telemetry.trace.load_chrome`` — one place owns the event shape."""
+    ``telemetry.trace.load_chrome`` — one place owns the event shape.
+    "X" records missing ``ts`` or ``dur`` are dropped AND counted into
+    the returned list's ``dropped_events`` (fabricating 0s would plant
+    phantom spans at the trace origin and corrupt self-time nesting)."""
     pname: dict[Any, str] = {}
     tname: dict[tuple, str] = {}
     for e in raw:
@@ -65,14 +78,17 @@ def events_from_chrome(raw: list) -> list[dict[str, Any]]:
                 pname[e.get("pid")] = e["args"]["name"]
             elif e.get("name") == "thread_name":
                 tname[(e.get("pid"), e.get("tid"))] = e["args"]["name"]
-    out = []
+    out = EventList()
     for e in raw:
         if not isinstance(e, dict) or e.get("ph") != "X":
             continue
+        if e.get("ts") is None or e.get("dur") is None:
+            out.dropped_events += 1
+            continue
         out.append({
             "name": e.get("name", "?"),
-            "ts": float(e.get("ts", 0.0)),
-            "dur": float(e.get("dur", 0.0)),
+            "ts": float(e["ts"]),
+            "dur": float(e["dur"]),
             "pid": e.get("pid"),
             "tid": e.get("tid"),
             "process": pname.get(e.get("pid"), str(e.get("pid"))),
@@ -83,9 +99,10 @@ def events_from_chrome(raw: list) -> list[dict[str, Any]]:
     return out
 
 
-def load(logdir: str) -> list[dict[str, Any]]:
-    """Read the newest trace in ``logdir``; returns complete-span events,
-    each annotated with its process/thread display names."""
+def load(logdir: str) -> EventList:
+    """Read the newest trace in ``logdir``; returns complete-span events
+    (an :class:`EventList` carrying the ``dropped_events`` count), each
+    annotated with its process/thread display names."""
     path = _latest_trace_file(logdir)
     with gzip.open(path, "rt") as f:
         data = json.load(f)

@@ -1,6 +1,6 @@
 """apex_tpu.telemetry — training-telemetry subsystem.
 
-Six pieces (see docs/telemetry.md):
+Seven pieces (see docs/telemetry.md):
 
   * :mod:`registry`  — counters/gauges/histograms/meters with a
     host-sync-batching ``step()`` context, rank-0-gated JSONL emission
@@ -22,9 +22,17 @@ Six pieces (see docs/telemetry.md):
     (Chrome counter tracks under the span rows), and the OOM
     post-mortem (``flight-oom-*.json``) the resilience guard writes on
     ``RESOURCE_EXHAUSTED``;
+  * :mod:`timeline`  — device-timeline decomposition over parsed
+    ``jax.profiler`` captures: per-device/per-step compute vs total vs
+    EXPOSED collective ms (exact interval subtraction), idle/stall
+    time, cross-device straggler z-scores (``timeline.straggler``
+    events), a correlated host+device Chrome merge, and the measured
+    ``exposed_comm_fraction`` that feeds the planner's
+    ``overlap_measured_fraction`` tuning key;
   * :mod:`report`    — JSONL → step-metrics summary +
     ``python -m apex_tpu.telemetry`` CLI (``trace <file>`` renders the
-    span-timeline summary, ``mem`` the peak-HBM table).
+    span-timeline summary, ``mem`` the peak-HBM table, ``timeline``
+    the per-device step decomposition).
 
 The reference has no counterpart: its observability is rank-0 prints
 and an ``AverageMeter`` whose docstring warns that printing costs an
@@ -38,6 +46,7 @@ from . import trace
 from . import registry
 from . import events
 from . import memory
+from . import timeline
 from .registry import (SCHEMA, Registry, Counter, Gauge, Histogram,
                        AverageMeter, Throughput, JsonlSink, MemorySink,
                        NULL_METRIC, record_violations, records_violations)
@@ -50,7 +59,8 @@ from .memory import (MemoryMonitor, memory_table, memory_model,
                      format_memory_table)
 
 __all__ = [
-    "trace", "registry", "events", "memory", "SCHEMA", "Registry",
+    "trace", "registry", "events", "memory", "timeline", "SCHEMA",
+    "Registry",
     "Counter", "Gauge",
     "Histogram", "AverageMeter", "Throughput", "JsonlSink", "MemorySink",
     "NULL_METRIC", "record_violations", "records_violations",

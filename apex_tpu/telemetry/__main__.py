@@ -6,8 +6,10 @@ renders the span-timeline summary from a Chrome-trace file (a
 jax-profiler run dir); ``python -m apex_tpu.telemetry mem [artifact]``
 renders the per-class peak-HBM attribution table (the flagship
 transformer step, a bench artifact's MFU/peak-HBM fields, or a
-``flight-oom-*.json`` post-mortem).  See ``report.main`` for the
-flags."""
+``flight-oom-*.json`` post-mortem); ``python -m apex_tpu.telemetry
+timeline <trace|profiler-dir>`` renders the per-device step
+decomposition (compute / comm / exposed-comm / idle ms + straggler
+skew) from a device trace.  See ``report.main`` for the flags."""
 from .report import main
 
 if __name__ == "__main__":

@@ -305,6 +305,12 @@ def main(argv=None) -> int:
         # a flight-oom post-mortem)
         from . import memory as _memory
         return _memory.cli(argv[1:])
+    if argv and argv[0] == "timeline":
+        # `python -m apex_tpu.telemetry timeline <trace|profiler-dir>`:
+        # the per-device step decomposition (compute / comm / EXPOSED
+        # comm / idle) + straggler skew from a device trace
+        from . import timeline as _timeline
+        return _timeline.cli(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.telemetry",

@@ -332,6 +332,7 @@ class SlowStepSentinel:
         self._cooldown_left = 0
         self._capture_steps_left = 0
         self._capturing = False
+        self._capture_tracer: Optional["Tracer"] = None
 
     def _stats(self):
         n = len(self.window)
@@ -340,7 +341,7 @@ class SlowStepSentinel:
         return mean, math.sqrt(var)
 
     # -- profiler capture (the one-shot window) -----------------------------
-    def _start_capture(self) -> bool:
+    def _start_capture(self, tracer: Optional["Tracer"] = None) -> bool:
         if (self.profile_dir is None or self._capturing
                 or self.captures >= self.max_captures):
             return False
@@ -350,6 +351,7 @@ class SlowStepSentinel:
         except Exception:      # profiler unavailable: the dump still lands
             return False
         self._capturing = True
+        self._capture_tracer = tracer
         self._capture_steps_left = self.profile_steps
         self.captures += 1
         # a run that crashes or ends INSIDE the window (exactly when an
@@ -364,13 +366,47 @@ class SlowStepSentinel:
         """Close an open profiler window now (idempotent) — called at
         the end of the profile_steps window, and registered as an
         atexit backstop so a crash mid-window still flushes the
-        capture."""
+        capture.  A flushed capture is then fed through the timeline
+        decomposition (:mod:`~apex_tpu.telemetry.timeline`) and the
+        per-step table dumped as a ``slow_step_timeline`` flight
+        document — the slow-step dump names WHEN it happened; this one
+        names WHERE the device time went."""
         if not self._capturing:
             return
         self._capturing = False
         try:
             import jax
             jax.profiler.stop_trace()
+        except Exception:
+            return          # nothing flushed: nothing to decompose
+        self._attach_timeline()
+
+    def _attach_timeline(self) -> None:
+        """Best-effort: decompose the just-flushed capture and attach
+        the per-step device table to a flight dump ``sections`` block.
+        Observability must never kill the train loop — any failure
+        (profiler wrote nothing, no device lanes, full disk) is
+        swallowed and the one-shot capture itself still stands."""
+        tr = self._capture_tracer
+        self._capture_tracer = None
+        if tr is None or self.profile_dir is None:
+            return
+        try:
+            from . import timeline as _timeline
+            decomp = _timeline.summarize(self.profile_dir)
+            if not decomp["devices"]:
+                return
+            tr.recorder.dump(
+                "slow_step_timeline",
+                directory=(self.dump_dir or tr.recorder.directory
+                           or self.profile_dir),
+                fields={"profile_dir": self.profile_dir,
+                        "n_devices": len(decomp["devices"]),
+                        "exposed_comm_ms":
+                            decomp["totals"]["exposed_comm_ms"]},
+                sections={"timeline": {
+                    "decomposition": decomp,
+                    "table": _timeline.format_decomposition(decomp)}})
         except Exception:
             pass
 
@@ -415,10 +451,10 @@ class SlowStepSentinel:
             return None
         self.fires += 1
         self._cooldown_left = self.cooldown
+        tr = tracer if tracer is not None else get_tracer()
         info = {"step": int(step), "step_seconds": float(seconds),
                 "baseline_mean_s": float(mean), "baseline_std_s": float(std),
-                "z": float(z), "profile_started": self._start_capture()}
-        tr = tracer if tracer is not None else get_tracer()
+                "z": float(z), "profile_started": self._start_capture(tr)}
         dump_path = None
         if tr is not None:
             tr.instant("sentinel.slow_step", **info)
@@ -833,5 +869,11 @@ def cli(argv=None) -> int:
     if not events:
         print(f"no complete spans in {args.trace}")
         return 1
+    dropped = getattr(events, "dropped_events", 0)
+    if dropped:
+        # the pyprof.parse droppedEvents counter: a truncated capture
+        # must announce itself, not just render thin
+        print(f"WARNING: {dropped} trace events dropped "
+              "(missing ts/dur — truncated capture?)")
     print(format_span_summary(span_summary(events), top=args.top))
     return 0

@@ -29,6 +29,10 @@ winners, and every tunable default consults it at trace time:
   - the auto-parallel plan (``plan_*`` keys) via
     ``parallel.plan.from_tuning`` — the measured winner of the bench
     ``plan`` A/B leg (the full dp/tp/sp + knob dict)
+  - the planner comm model's overlap factor
+    (``overlap_measured_fraction``) via ``parallel.plan.predict`` —
+    the exposed-comm fraction ``telemetry.timeline`` measured from the
+    bench one-step profiled capture
 
 Precedence everywhere: explicit argument > env override > tuning
 profile > built-in default.  With no profile on disk nothing changes —
@@ -106,6 +110,14 @@ SCHEMA = {
     # unless the measured winner explicitly quantized its gather)
     "plan_allgather_scheme": lambda v: v in ("fp32", "bf16",
                                              "int8_blockscale"),
+    # measured exposed-comm fraction from the bench one-step profiled
+    # capture (telemetry.timeline over the spmd leg's device trace) —
+    # the overlap factor parallel.plan's comm model consumes: exposed
+    # dp comm = modeled comm x fraction.  1.0 = fully synchronous
+    # (today's engine); the async-collective rewrite will lower it
+    "overlap_measured_fraction": lambda v: (isinstance(v, (int, float))
+                                            and not isinstance(v, bool)
+                                            and 0.0 <= v <= 1.0),
 }
 
 

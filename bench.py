@@ -253,6 +253,45 @@ def leg_telemetry(step_ms_samples, fields, counters=None):
                              gauges=gauges)
 
 
+def _profiled_overlap_capture(run_one_step, profile_dir):
+    """Opt-in ONE-STEP profiled capture (``APEX_BENCH_PROFILE_DIR``):
+    open a ``jax.profiler`` window around exactly one already-compiled
+    step, then feed the capture through the device-timeline
+    decomposition (``telemetry.timeline``).  Returns ``(overlap_block,
+    decomp)`` — the block is the artifact-embeddable evidence (compute/
+    comm/EXPOSED-comm ms + the ``exposed_comm_fraction`` that
+    ``apply_perf_results`` persists as the ``overlap_measured_fraction``
+    tuning key); ``decomp`` feeds the leg registry's ``step.*`` gauges.
+    Best-effort: a profiler-less backend records its error and the leg
+    keeps its timing numbers."""
+    import jax
+    from apex_tpu.telemetry import timeline as tl
+    try:
+        jax.profiler.start_trace(profile_dir)
+        try:
+            run_one_step()
+        finally:
+            jax.profiler.stop_trace()
+    except Exception as err:
+        return {"profile_dir": profile_dir,
+                "error": repr(err)[:160]}, None
+    try:
+        decomp = tl.summarize(profile_dir)
+    except Exception as err:
+        return {"profile_dir": profile_dir,
+                "error": repr(err)[:160]}, None
+    t = decomp["totals"]
+    block = {"profile_dir": profile_dir,
+             "devices": len(decomp["devices"]),
+             "steps": decomp["n_steps"],
+             "compute_ms": t["compute_ms"], "comm_ms": t["comm_ms"],
+             "exposed_comm_ms": t["exposed_comm_ms"],
+             "idle_ms": t["idle_ms"],
+             "exposed_comm_fraction": t["exposed_comm_fraction"],
+             "stragglers": len(decomp["stragglers"])}
+    return block, decomp
+
+
 def _mem_fields(jitted, args):
     """Peak-HBM fields for a timed leg (ISSUE 6 satellite).  On TPU:
     the device allocator's live/peak counters — one free host call, no
@@ -1114,6 +1153,10 @@ def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
     out = {"leg": "spmd", "chips": n_dev, "global_batch": gb,
            "families": {}}
     base_loss = None
+    # opt-in one-step profiled capture (the overlap measurement; the
+    # watcher's stage 2e sets this so stage 2f can decompose it)
+    profile_dir = os.environ.get("APEX_BENCH_PROFILE_DIR")
+    overlap_decomp = None
     prev = tel_events.set_default(reg)
     try:
         for name, p in plans:
@@ -1130,6 +1173,19 @@ def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
                     carry, loss = step(carry, tokens)
                 _sync(loss)
                 ms = (time.perf_counter() - t0) / steps * 1e3
+                if name == "dp_baseline" and profile_dir:
+                    # capture the warmed dp step: one profiled step ->
+                    # per-device decomposition -> the measured exposed-
+                    # comm fraction the planner's overlap factor needs
+                    _log(f"spmd leg: one-step profiled capture -> "
+                         f"{profile_dir}")
+
+                    def _one_step(_carry=carry):
+                        _, l = step(_carry, tokens)
+                        _sync(l)
+
+                    out["overlap"], overlap_decomp = \
+                        _profiled_overlap_capture(_one_step, profile_dir)
             loss = float(loss)
             if name == "dp_baseline":
                 base_loss = loss
@@ -1150,6 +1206,12 @@ def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
             gc.collect()
     finally:
         tel_events.set_default(prev)
+    if overlap_decomp is not None:
+        # step.device_compute_ms / step.exposed_comm_ms /
+        # step.device_idle_ms gauges + timeline.straggler events ride
+        # the leg registry's batched flush below
+        from apex_tpu.telemetry import timeline as tlmod
+        tlmod.observe(overlap_decomp, reg)
     reg.flush()
     out["telemetry"] = {"records": sink.records,
                         "summary": treport.summarize(sink.records)}

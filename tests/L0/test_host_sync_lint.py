@@ -13,6 +13,11 @@ inherently host-side boundary):
   * ``telemetry/registry.py``  — the single batched flush read
   * ``telemetry/events.py``    — the batched scaler-state read
   * ``telemetry/memory.py``    — the allocator poll at flush cadence
+  * ``telemetry/timeline.py``  — offline profiler-dir parsing: its file
+    reads happen in tooling/post-capture context, never inside a train
+    step; sanctioned explicitly so future capture helpers that need a
+    sync boundary (closing a profiler window flushes the device) have
+    a documented home
   * ``resilience/guard.py``    — the batched health-check/snapshot read
   * ``checkpoint.py``          — serialization is a host operation
   * ``interop/__init__.py``    — the torch bridge is host-side by design
@@ -39,6 +44,7 @@ SANCTIONED = {
     os.path.join("telemetry", "registry.py"),
     os.path.join("telemetry", "events.py"),
     os.path.join("telemetry", "memory.py"),
+    os.path.join("telemetry", "timeline.py"),
     os.path.join("resilience", "guard.py"),
     "checkpoint.py",
     os.path.join("interop", "__init__.py"),

@@ -135,6 +135,29 @@ def test_parse_self_time_nesting():
     assert "$main.py:1 step" in withpy
 
 
+def test_events_from_chrome_counts_dropped_events():
+    """ISSUE 13 satellite: complete ("X") records missing ts/dur —
+    a profiler killed mid-flush writes torn records — are DROPPED and
+    counted into the returned list's ``dropped_events`` (mirroring the
+    Tracer's ``droppedSpans``), never silently parsed as phantom spans
+    at the trace origin."""
+    from apex_tpu.pyprof import parse
+    raw = [
+        {"ph": "X", "name": "ok", "ts": 0.0, "dur": 5.0, "pid": 1,
+         "tid": 1},
+        {"ph": "X", "name": "no_dur", "ts": 1.0, "pid": 1, "tid": 1},
+        {"ph": "X", "name": "no_ts", "dur": 2.0, "pid": 1, "tid": 1},
+        {"ph": "C", "name": "counter", "pid": 1},   # not "X": not counted
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "p"}},
+    ]
+    evs = parse.events_from_chrome(raw)
+    assert [e["name"] for e in evs] == ["ok"]
+    assert evs.dropped_events == 2
+    # a clean trace counts zero
+    assert parse.events_from_chrome(raw[:1]).dropped_events == 0
+
+
 def test_parse_equal_bound_twins_not_negative():
     """Two spans with identical (ts, dur) on one thread — seen in real
     Chrome traces for zero/equal-length nested spans — must not debit

@@ -553,6 +553,74 @@ def test_spmd_ab_stage_artifact_and_span(tmp_path):
     assert not (tmp_path / "SPMD_FAIL.json.run").exists()
 
 
+def _write_spmd_capture(tmp_path, dirname="SPMD_PROFILE_r5"):
+    """A jax-profiler run-dir fixture where the stage-2e capture would
+    land (the TensorBoard plugins/profile layout)."""
+    import gzip
+    d = tmp_path / dirname / "plugins" / "profile" / "run_1"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 10,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "name": "fusion.1", "ts": 0, "dur": 100, "pid": 10,
+         "tid": 1, "args": {}},
+        {"ph": "X", "name": "all-reduce.2", "ts": 50, "dur": 100,
+         "pid": 10, "tid": 1, "args": {}},
+    ]
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        f.write(json.dumps({"traceEvents": events}))
+
+
+def test_timeline_stage_over_spmd_capture(tmp_path):
+    """ISSUE 13 satellite: stage 2f runs the REAL timeline CLI over the
+    stage-2e spmd profiler capture — skip-when-absent (no capture dir,
+    no stage), atomic artifact, ``watch.timeline`` span, and a failing
+    decomposition leaves no truncated artifact behind."""
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    }
+    # window 1: no capture dir -> the stage is skipped silently
+    r, log = run_watch(tmp_path, base)
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    assert not (tmp_path / "TIMELINE_r5.json").exists()
+    assert "timeline decomposition done" not in log
+    # window 2: the capture exists -> the default (real) CLI decomposes
+    # it into the artifact and the span lands on the streaming timeline
+    _write_spmd_capture(tmp_path)
+    (tmp_path / "TUNNEL_LIVE").unlink()
+    r2, log2 = run_watch(tmp_path, base, timeout=180)
+    assert r2.returncode == 0, (r2.stdout, r2.stderr, log2)
+    assert "timeline decomposition done rc=0" in log2
+    art = json.loads((tmp_path / "TIMELINE_r5.json").read_text())
+    assert art["kind"] == "device_timeline"
+    assert abs(art["totals"]["exposed_comm_ms"] - 0.050) < 1e-9
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.timeline" in names
+    # window 3: artifact present -> stage skipped (span count unchanged)
+    (tmp_path / "TUNNEL_LIVE").unlink()
+    r3, log3 = run_watch(tmp_path, base, timeout=180)
+    assert r3.returncode == 0
+    names3 = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert names3.count("watch.timeline") == 1
+
+    # a failing decomposition leaves no truncated artifact behind
+    (tmp_path / "TUNNEL_LIVE").unlink()
+    r4, log4 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_TIMELINE_JSON": "TL_FAIL.json",
+        "APEX_WATCH_TIMELINE_CMD": "echo '{\"partial\":true'; false",
+    }, timeout=180)
+    assert r4.returncode == 0
+    assert "timeline decomposition done rc=1" in log4
+    assert not (tmp_path / "TL_FAIL.json").exists()
+    assert not (tmp_path / "TL_FAIL.json.run").exists()
+
+
 def test_elastic_stage_artifact_and_span(tmp_path):
     """ISSUE 11 satellite: the elastic kill-8-resume-4 proof runs as
     watch stage 3b — artifact written atomically, `watch.elastic` span

@@ -376,6 +376,37 @@ def test_cli_trace_renders_guard_driven_span_summary(tmp_path):
     assert "p50 us" in r.stdout and "p99 us" in r.stdout
 
 
+def test_cli_trace_profiler_dir_fixture(tmp_path):
+    """ISSUE 13 satellite: the trace CLI's jax-profiler-DIR branch on a
+    run-dir fixture (the TensorBoard ``plugins/profile/<run>/*.trace.
+    json.gz`` layout) — previously only exercised implicitly — plus the
+    new droppedEvents visibility for torn records."""
+    import gzip
+    d = tmp_path / "plugins" / "profile" / "run_1"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 10,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "name": "fusion.1", "ts": 0, "dur": 100, "pid": 10,
+         "tid": 1, "args": {}},
+        {"ph": "X", "name": "all-reduce.2", "ts": 50, "dur": 100,
+         "pid": 10, "tid": 1, "args": {}},
+        {"ph": "X", "name": "torn-span", "pid": 10, "tid": 1},  # no ts/dur
+    ]
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump({"displayTimeUnit": "ms", "traceEvents": events}, f)
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.telemetry", "trace",
+         str(tmp_path)],
+        capture_output=True, text=True, cwd=ROOT, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "span timeline summary" in r.stdout
+    assert "fusion.1" in r.stdout and "all-reduce.2" in r.stdout
+    # the torn record is announced, not silently thin
+    assert "1 trace events dropped" in r.stdout
+
+
 def test_load_chrome_streaming_array(tmp_path):
     """The tpu_watch.sh stage timeline is a NEVER-CLOSED JSON array
     (crash-safe appends); the loader must read it anyway."""

@@ -58,6 +58,14 @@ and TPU-backed; absent keys leave the built-in defaults untouched):
                            elect zero1 for a config that won't be
                            consumed), and when it wins it also pins
                            ddp_update_allgather_scheme
+  overlap_measured_fraction
+                        <- the bench one-step profiled capture
+                           (``telemetry.timeline`` over the spmd leg's
+                           device trace): the measured EXPOSED-comm
+                           fraction, consumed by ``parallel.plan``'s
+                           comm model as its overlap factor; only
+                           persisted when the capture actually
+                           measured collective time (comm_ms > 0)
   plan_*                <- the bench ``plan`` A/B leg (auto-parallel
                            planner, parallel.plan): the MEASURED
                            winner's full knob dict (dp/tp/sp + zero /
@@ -295,6 +303,58 @@ def update_sharding_violations(artifact) -> list:
                             and ratio >= 3.5):
                         out.append(f"{path}: {mode} allgather ratio "
                                    f"{ratio!r} < 3.5")
+        for k, v in node.items():
+            if k != "telemetry":
+                walk(v, f"{path}.{k}")
+
+    walk(artifact if isinstance(artifact, dict) else {}, "artifact")
+    return out
+
+
+def overlap_violations(artifact) -> list:
+    """Audit for the one-step profiled-capture ``overlap`` block
+    (ISSUE 13): a leg that embeds one must carry consistent exposed-
+    comm evidence — numeric compute/comm/exposed ms, exposed <= comm
+    (interval subtraction can never create time), and a fraction in
+    [0, 1] that matches exposed/comm.  A block carrying only an
+    ``error`` field is an honestly-failed capture and passes (the leg
+    keeps its timing numbers).  Warnings only, same posture as the
+    other audits."""
+    out = []
+
+    def walk(node, path):
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+            return
+        if not isinstance(node, dict):
+            return
+        ov = node.get("overlap")
+        if isinstance(ov, dict) and "error" not in ov:
+            nums = {k: ov.get(k) for k in ("compute_ms", "comm_ms",
+                                           "exposed_comm_ms")}
+            bad = [k for k, v in nums.items()
+                   if not isinstance(v, (int, float))]
+            if bad:
+                out.append(f"{path}.overlap: non-numeric {bad}")
+            else:
+                if ov["exposed_comm_ms"] > ov["comm_ms"] + 1e-6:
+                    out.append(f"{path}.overlap: exposed_comm_ms "
+                               f"{ov['exposed_comm_ms']} > comm_ms "
+                               f"{ov['comm_ms']}")
+                frac = ov.get("exposed_comm_fraction")
+                if ov["comm_ms"] > 0:
+                    if not (isinstance(frac, (int, float))
+                            and 0.0 <= frac <= 1.0):
+                        out.append(f"{path}.overlap: bad "
+                                   f"exposed_comm_fraction {frac!r}")
+                    elif abs(frac - ov["exposed_comm_ms"]
+                             / ov["comm_ms"]) > 1e-3:
+                        out.append(f"{path}.overlap: fraction {frac} "
+                                   "inconsistent with exposed/comm")
+                elif frac is not None:
+                    out.append(f"{path}.overlap: fraction {frac!r} "
+                               "claimed with no measured comm")
         for k, v in node.items():
             if k != "telemetry":
                 walk(v, f"{path}.{k}")
@@ -654,6 +714,33 @@ def decide(bench, kern):
                         f"winning variant's metered allgather "
                         f"ratio {zrows[best_z]['ag_ratio']}x"))
 
+        spmd_leg = det.get("spmd")
+        if isinstance(spmd_leg, dict) \
+                and spmd_leg.get("_backend") in (None, "tpu") \
+                and isinstance(spmd_leg.get("overlap"), dict):
+            # overlap_measured_fraction <- the one-step profiled
+            # capture's exposed-comm fraction.  Only with measured
+            # collective time behind it (comm_ms > 0) and a clean
+            # audit — a fraction from a comm-free or inconsistent
+            # capture says nothing the planner should consume.
+            ov = spmd_leg["overlap"]
+            frac = ov.get("exposed_comm_fraction")
+            if "error" not in ov \
+                    and isinstance(frac, (int, float)) \
+                    and not isinstance(frac, bool) \
+                    and 0.0 <= frac <= 1.0 \
+                    and isinstance(ov.get("comm_ms"), (int, float)) \
+                    and ov["comm_ms"] > 0 \
+                    and not overlap_violations({"overlap": ov}):
+                prof["overlap_measured_fraction"] = round(float(frac), 4)
+                rows.append((
+                    "overlap_measured_fraction",
+                    f"{prof['overlap_measured_fraction']}",
+                    f"one-step profiled capture: exposed "
+                    f"{ov.get('exposed_comm_ms')} ms of "
+                    f"{ov.get('comm_ms')} ms collective time over "
+                    f"{ov.get('devices')} devices"))
+
         pl = det.get("plan")
         if isinstance(pl, dict) and pl.get("_backend") in (None, "tpu") \
                 and isinstance(pl.get("plans"), list):
@@ -752,6 +839,10 @@ def main(argv=None):
             # and the plan A/B leg (measured rows + the >25%
             # calibration drift guard)
             for v in plan_violations(art):
+                print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
+            # and any one-step profiled-capture overlap block (the
+            # exposed-comm evidence must be internally consistent)
+            for v in overlap_violations(art):
                 print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
 
     prof, rows = decide(bench, kern)

@@ -61,7 +61,11 @@ r = p(50); print(r.detail); raise SystemExit(0 if r else 1)"'}
 # exercise don't even compile/match on this chip+toolchain.
 SMOKE_CMD=${APEX_WATCH_SMOKE_CMD:-"python tools/tpu_smoke.py"}
 SMOKE_TO=${APEX_WATCH_SMOKE_TO:-90}
-BENCH_CMD=${APEX_WATCH_BENCH_CMD:-"python bench.py --inner --legs-dir $BENCH_LEGS"}
+# the full bench's spmd leg opens the ONE-STEP profiled capture
+# (ISSUE 13): its measured exposed-comm fraction lands in the artifact
+# apply_perf_results reads, and stage 2f decomposes the capture dir
+SPMD_PROFILE=${APEX_WATCH_SPMD_PROFILE:-SPMD_PROFILE_r5}
+BENCH_CMD=${APEX_WATCH_BENCH_CMD:-"APEX_BENCH_PROFILE_DIR=$SPMD_PROFILE python bench.py --inner --legs-dir $BENCH_LEGS"}
 KERN_CMD=${APEX_WATCH_KERN_CMD:-"python bench_kernels.py --inner --legs-dir $KERN_LEGS"}
 ASSEMBLE_CMD=${APEX_WATCH_ASSEMBLE_CMD:-"python -m apex_tpu.utils.bench_legs"}
 APPLY_CMD=${APEX_WATCH_APPLY_CMD:-"python tools/apply_perf_results.py --notes PERF_NOTES.md"}
@@ -123,10 +127,22 @@ PLAN_TO=${APEX_WATCH_PLAN_TO:-400}
 # contrib ZeRO) vs the dp baseline, with the compiled-HLO collective
 # sub-table + tp.psum/sp.all_to_all meters embedded; the on-chip proof
 # that every planner family actually RUNS.  ${VAR-default}: an
-# explicitly EMPTY override disables it
-SPMD_CMD=${APEX_WATCH_SPMD_CMD-"python bench.py --spmd"}
+# explicitly EMPTY override disables it.  The default command also
+# opens the ONE-STEP profiled capture (APEX_BENCH_PROFILE_DIR, shared
+# with the full bench stage above) whose device trace stage 2f
+# decomposes into exposed-comm evidence.
+SPMD_CMD=${APEX_WATCH_SPMD_CMD-"APEX_BENCH_PROFILE_DIR=$SPMD_PROFILE python bench.py --spmd"}
 SPMD_JSON=${APEX_WATCH_SPMD_JSON:-SPMD_AB_r5.json}
 SPMD_TO=${APEX_WATCH_SPMD_TO:-400}
+# stage 2f: device-timeline decomposition (ISSUE 13) over the stage-2e
+# profiled capture — per-device compute / comm / EXPOSED-comm / idle ms
+# + straggler skew, one JSON artifact.  Skip-when-absent: without the
+# capture dir there is nothing to decompose (the spmd leg may have run
+# without the profiler, or not at all this window).  ${VAR-default}:
+# an explicitly EMPTY override disables the stage
+TL_CMD=${APEX_WATCH_TIMELINE_CMD-"python -m apex_tpu.telemetry timeline $SPMD_PROFILE --json"}
+TL_JSON=${APEX_WATCH_TIMELINE_JSON:-TIMELINE_r5.json}
+TL_TO=${APEX_WATCH_TIMELINE_TO:-120}
 INTEROP_CMD=${APEX_WATCH_INTEROP_CMD:-"python tools/bench_interop.py"}
 INTEROP_JSON=${APEX_WATCH_INTEROP_JSON:-INTEROP_r5.json}
 INTEROP_TO=${APEX_WATCH_INTEROP_TO:-600}
@@ -320,6 +336,22 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$SPMD_JSON".run
       fi
       echo "$(date +%H:%M:%S) spmd A/B done rc=$rcs" >> "$LOG"
+    fi
+    # ---- stage 2f: timeline decomposition of the 2e capture ----
+    # skip-when-absent (no profiled capture this window) and
+    # skip-when-complete, atomic artifact like the other short stages
+    if [ -n "$TL_CMD" ] && [ ! -s "$TL_JSON" ] && [ -d "$SPMD_PROFILE" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$TL_TO" bash -c "$TL_CMD" > "$TL_JSON".run 2>> "$LOG"
+      rct=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span timeline "$t0" "$rct"
+      if [ $rct -eq 0 ] && [ -s "$TL_JSON".run ]; then
+        mv "$TL_JSON".run "$TL_JSON"
+      else
+        # a failed decomposition never leaves a truncated artifact
+        rm -f "$TL_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) timeline decomposition done rc=$rct" >> "$LOG"
     fi
     # ---- stage 3a: guard-driven resumable train (incremental) ----
     # BEFORE the all-or-nothing save/resume leg: the guard leg makes
