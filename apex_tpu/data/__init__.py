@@ -15,9 +15,28 @@ N+1..N+depth.
 
 Degrades to a Python-thread fallback when no C++ toolchain is available
 (same API, same ring/overlap structure, GIL-bound fills).
+
+The SEEKABLE half of the data plane lives in :mod:`.sharded`
+(docs/data.md "Seekable shard-addressed datasets"): checksummed
+``.npz`` shard datasets with a pure ``(seed, epoch, step, world) ->
+(shard, offset)`` addressing function, so ``ShardedLoader(step)``
+replays any global step bitwise — the loader protocol TrainGuard's
+rollback/replay and the elastic N->M resume need on real data.
 """
 from .loader import (ArraySource, LoaderStallError, NativeLoader,
                      SyntheticSource, native_available)
+from .sharded import (INDEX, DatasetError, IndexMissingWarning,
+                      ShardChecksumError, ShardIndex, ShardInfo,
+                      ShardedDataset, ShardedLoader, build_index,
+                      epoch_permutation, global_records, host_records,
+                      load_index, locate_step, open_dataset,
+                      steps_per_epoch)
 
 __all__ = ["ArraySource", "LoaderStallError", "NativeLoader",
-           "SyntheticSource", "native_available"]
+           "SyntheticSource", "native_available",
+           "INDEX", "DatasetError", "IndexMissingWarning",
+           "ShardChecksumError", "ShardIndex", "ShardInfo",
+           "ShardedDataset", "ShardedLoader", "build_index",
+           "epoch_permutation", "global_records", "host_records",
+           "load_index", "locate_step", "open_dataset",
+           "steps_per_epoch"]

@@ -118,6 +118,66 @@ def _record_loader(depth, wait_s) -> None:
     _tel_events.record_loader(depth, wait_s)
 
 
+def _record_retry(batch_index, attempt, waited_s, next_wait_s) -> None:
+    """Telemetry for one bounded-retry attempt inside the timed wait
+    (``loader.retry`` event + counter): the stall did not escalate YET
+    — the consumer is waiting again with a doubled budget.  Import kept
+    local like every other hook so the loader stays importable without
+    the apex_tpu package root."""
+    try:
+        from ..telemetry import events as _tel_events
+    except ImportError:  # pragma: no cover - standalone module use
+        return
+    _tel_events.record_loader_retry(batch_index, attempt, waited_s,
+                                    next_wait_s)
+
+
+def _timed_get(q, batch_index: int, wait_timeout, stall_retries: int):
+    """The consumer-side dequeue discipline shared by the python ring
+    and :class:`~apex_tpu.data.sharded.ShardedLoader`: injected
+    ``loader_stall`` faults count against the first wait window; an
+    empty queue is retried up to ``stall_retries`` times with
+    exponentially growing budgets (each attempt metered as a
+    ``loader.retry`` event) before the typed :class:`LoaderStallError`;
+    a batch that ARRIVES after the total allowed budget is the same
+    wedge signal, detected post-hoc.  Returns ``(item, wait_seconds)``.
+    """
+    import queue as _q
+    import time as _time
+    t0 = _time.perf_counter()
+    _fault_stall(batch_index)    # injected stall counts as wait
+    if wait_timeout is None:
+        return q.get(), _time.perf_counter() - t0
+    allowed = wait_timeout
+    budget = max(wait_timeout - (_time.perf_counter() - t0), 0.0)
+    attempt = 0
+    while True:
+        try:
+            item = q.get(timeout=budget)
+            break
+        except _q.Empty:
+            if attempt >= stall_retries:
+                raise LoaderStallError(
+                    f"loader stalled: no batch within {wait_timeout}s "
+                    f"(+{attempt} backoff retries) on batch "
+                    f"{batch_index}") from None
+            attempt += 1
+            budget = wait_timeout * (2 ** (attempt - 1))
+            allowed += budget
+            _record_retry(batch_index, attempt,
+                          _time.perf_counter() - t0, budget)
+    wait = _time.perf_counter() - t0
+    if wait > allowed:
+        # a batch that ARRIVED late (e.g. an injected stall with a
+        # still-full ring) is the same wedge signal as an empty queue —
+        # detect it post-hoc like the native path does
+        raise LoaderStallError(
+            f"loader stalled {wait:.2f}s (> wait_timeout={wait_timeout}s"
+            + (f" + {attempt} retries" if attempt else "")
+            + f") on batch {batch_index}")
+    return item, wait
+
+
 def _note_fill_span(batch_index, fill_s) -> None:
     """Producer-side ``loader.fill`` span (docs/telemetry.md tracing):
     how long each batch took to ASSEMBLE, recorded from the fill
@@ -201,16 +261,21 @@ class NativeLoader:
     device_put: set False to receive numpy copies instead of device arrays
     (e.g. when the consumer shards the batch itself).
     wait_timeout: seconds the consumer tolerates waiting for one batch
-    before raising :class:`LoaderStallError` (None = wait forever).  On
-    the python ring the wait itself is bounded; the native ring's
-    acquire is an uninterruptible C call, so detection there is post-hoc
-    (the stall is reported as soon as the wedged acquire returns).
+    before escalating (None = wait forever).  On the python ring an
+    empty queue is retried ``stall_retries`` times with exponentially
+    growing budgets (metered as ``loader.retry`` events) before the
+    typed :class:`LoaderStallError` — a transient producer hiccup heals
+    without killing the run, a real wedge still escalates to the same
+    typed error.  The native ring's acquire is an uninterruptible C
+    call, so detection there is post-hoc (the stall is reported as soon
+    as the wedged acquire returns; no retry applies).
     """
 
     def __init__(self, source, batch_size: int, steps: int, *,
                  depth: int = 3, threads: int = 2, seed: int = 0,
                  device_put: bool = True,
-                 wait_timeout: Optional[float] = None):
+                 wait_timeout: Optional[float] = None,
+                 stall_retries: int = 2):
         self.source = source
         self.batch_size = int(batch_size)
         self.steps = int(steps)
@@ -220,6 +285,7 @@ class NativeLoader:
         self.device_put = device_put
         self.wait_timeout = (None if wait_timeout is None
                              else float(wait_timeout))
+        self.stall_retries = int(stall_retries)
         self._shape = (self.batch_size,) + tuple(source.shape)
 
     # -- iteration ---------------------------------------------------------
@@ -336,34 +402,13 @@ class NativeLoader:
         th = threading.Thread(target=producer, daemon=True)
         th.start()
         try:
-            import time as _time
-
             import jax
             step = 0
             while True:
-                t0 = _time.perf_counter()
-                _fault_stall(step)       # injected stall counts as wait
+                item, wait = _timed_get(q, step, self.wait_timeout,
+                                        self.stall_retries)
                 step += 1
-                try:
-                    budget = self.wait_timeout
-                    if budget is not None:
-                        budget = max(budget - (_time.perf_counter() - t0),
-                                     0.0)
-                    item = q.get(timeout=budget)
-                except _q.Empty:
-                    raise LoaderStallError(
-                        f"loader stalled: no batch within "
-                        f"{self.wait_timeout}s (batch {step - 1})") from None
-                wait = _time.perf_counter() - t0
                 _record_loader(q.qsize(), wait)
-                if self.wait_timeout is not None and wait > self.wait_timeout:
-                    # a batch that ARRIVED late (e.g. an injected stall
-                    # with a still-full ring) is the same wedge signal as
-                    # an empty queue — detect it post-hoc like the
-                    # native path does
-                    raise LoaderStallError(
-                        f"loader stalled {wait:.2f}s (> wait_timeout="
-                        f"{self.wait_timeout}s) on batch {step - 1}")
                 if item is None:
                     return
                 if isinstance(item, BaseException):

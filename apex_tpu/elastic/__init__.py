@@ -74,15 +74,15 @@ from typing import Optional
 import numpy as np
 
 from ..resilience.ckpt import (ManifestCompatWarning, WorldSizeMismatchError,
-                               META_LAYOUT_KEY, META_PLAN_KEY,
-                               META_WORLD_KEY)
+                               META_DATA_KEY, META_LAYOUT_KEY,
+                               META_PLAN_KEY, META_WORLD_KEY)
 from ..parallel import collectives as _coll
 from ..parallel import plan as _plan
 
 __all__ = [
     "ElasticResume", "ManifestCompatWarning", "WorldSizeMismatchError",
-    "can_reshard", "install", "installed", "replan", "reshard_payload",
-    "uninstall",
+    "can_reshard", "install", "installed", "repartition_data", "replan",
+    "reshard_payload", "uninstall",
 ]
 
 
@@ -208,6 +208,53 @@ def reshard_payload(template_state, payload: dict, saved_meta: dict,
     return {**payload, "leaves": out}
 
 
+def repartition_data(saved_meta: dict, live_world: int, *,
+                     emit=None) -> Optional[dict]:
+    """Re-partition the data-plane shard assignment for a resume at a
+    new ingest-world size — the data half of the optimizer reshard.
+
+    The seekable data plane (``data.sharded``) makes this DETERMINISTIC
+    and cheap: the global batch of any step depends only on
+    ``(seed, epoch, step)``, never on the host count, so N→M
+    re-assignment is just re-slicing the same record stream — no record
+    dropped, none duplicated (``tests/L0/test_data_sharded.py`` proves
+    the round trip).  What remains at resume time is validation + the
+    audit event: the saved ``meta["data"]`` block must exist (else
+    None — nothing to re-partition, e.g. a synthetic source) and the
+    recorded ``global_batch`` must divide over ``live_world`` (else a
+    typed :class:`WorldSizeMismatchError` with detail — a batch that
+    cannot shard M ways is a configuration change, not a resize).
+
+    Emits one ``elastic.data_repartition`` event naming both worlds,
+    the cursor step being re-sought, and the per-host record count, and
+    returns the new assignment facts (``from_world``/``to_world``/
+    ``records_per_host``/``cursor``)."""
+    data = saved_meta.get(META_DATA_KEY) if isinstance(saved_meta, dict) \
+        else None
+    if not isinstance(data, dict) or not data.get("global_batch"):
+        return None
+    emit = emit or _emit_default
+    gb = int(data["global_batch"])
+    from_world = int(data.get("world") or 1)
+    live_world = int(live_world)
+    if live_world < 1 or gb % live_world:
+        raise WorldSizeMismatchError(
+            saved_meta.get(META_WORLD_KEY) or from_world, live_world,
+            detail=f"data-plane global_batch {gb} cannot be "
+                   f"re-partitioned over {live_world} ingest hosts")
+    cursor = data.get("cursor") if isinstance(data.get("cursor"), dict) \
+        else {}
+    out = {"from_world": from_world, "to_world": live_world,
+           "global_batch": gb, "records_per_host": gb // live_world,
+           "index_digest": data.get("index_digest"),
+           "cursor": cursor}
+    emit("elastic.data_repartition", step=cursor.get("step"),
+         from_world=from_world, to_world=live_world, global_batch=gb,
+         records_per_host=gb // live_world,
+         index_digest=data.get("index_digest"))
+    return out
+
+
 def replan(chips: int, *, profile=None, saved_knobs: Optional[dict] = None,
            emit=None, **search_kw) -> Optional[_plan.Plan]:
     """Re-run the auto-parallel cost-model search for a NEW chip count
@@ -252,11 +299,19 @@ class ElasticResume:
     profile: object = None
     search_kw: dict = dataclasses.field(default_factory=dict)
     last_plan: Optional[_plan.Plan] = None
+    #: the data-plane re-partition of the last resume (None when the
+    #: manifest carried no data block) — :func:`repartition_data`
+    last_data: Optional[dict] = None
 
     def resume(self, template_state, payload: dict, saved_meta: dict,
                live_world: int, *, emit=None) -> dict:
         out = reshard_payload(template_state, payload, saved_meta,
                               live_world, emit=emit)
+        # the optimizer reshard's data-plane twin: re-partition the
+        # shard assignment for the new world (pure validation + audit
+        # event — the addressing itself is world-free by construction)
+        self.last_data = repartition_data(saved_meta, live_world,
+                                          emit=emit)
         if self.profile is not None:
             self.last_plan = replan(
                 live_world, profile=self.profile,

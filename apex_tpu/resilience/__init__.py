@@ -22,8 +22,8 @@ See ``docs/resilience.md`` for the guard lifecycle, the fault-spec
 grammar, and the resume protocol.
 """
 from . import ckpt, faults, guard
-from .ckpt import (MANIFEST, CheckpointManager, ManifestCompatWarning,
-                   WorldSizeMismatchError)
+from .ckpt import (MANIFEST, CheckpointManager, DataStreamMismatchError,
+                   ManifestCompatWarning, WorldSizeMismatchError)
 from .faults import (CollectiveFault, FaultError, FaultPlan, FaultSpec,
                      StallingIterator, active_plan, corrupt, install,
                      maybe_stall, parse, wrap_collective)
@@ -34,7 +34,8 @@ from ..data.loader import LoaderStallError
 __all__ = [
     "ckpt", "faults", "guard",
     "CheckpointManager", "MANIFEST", "CheckpointError",
-    "ManifestCompatWarning", "WorldSizeMismatchError",
+    "DataStreamMismatchError", "ManifestCompatWarning",
+    "WorldSizeMismatchError",
     "FaultPlan", "FaultSpec", "FaultError", "CollectiveFault",
     "StallingIterator", "parse", "install", "active_plan", "corrupt",
     "maybe_stall", "wrap_collective", "LoaderStallError",

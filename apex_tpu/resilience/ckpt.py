@@ -41,6 +41,12 @@ MANIFEST = "MANIFEST.json"
 META_LAYOUT_KEY = "layout"
 META_WORLD_KEY = "world_size"
 META_PLAN_KEY = "plan"
+#: the data-plane block (docs/data.md): the loader's ``data_meta()``
+#: facts (index digest, n_records, global_batch, seed, ingest world)
+#: plus the latest checkpoint's ``cursor`` (epoch / epoch_step / shard
+#: position) — what lets a resume SEEK the stream instead of
+#: restarting it, and an elastic resize re-partition the same stream
+META_DATA_KEY = "data"
 
 
 class WorldSizeMismatchError(CheckpointError):
@@ -61,6 +67,25 @@ class WorldSizeMismatchError(CheckpointError):
         if detail:
             msg += f" [{detail}]"
         super().__init__(msg)
+
+
+class DataStreamMismatchError(CheckpointError):
+    """The checkpoint manifest records a data-plane cursor for a
+    DIFFERENT dataset than the one this run is feeding from (the index
+    digests disagree).  Seeking a changed stream would silently void
+    the bitwise replay guarantee, so the mismatch is loud and typed —
+    re-point the run at the original shard set, or start a fresh
+    checkpoint directory for the new one."""
+
+    def __init__(self, saved_digest: str, live_digest: str):
+        self.saved_digest = str(saved_digest)
+        self.live_digest = str(live_digest)
+        super().__init__(
+            "checkpoint manifest records data-plane cursor for dataset "
+            f"index digest {saved_digest[:16]}… but the live loader "
+            f"feeds from {live_digest[:16]}… — the dataset changed "
+            "under the checkpoint; seek-to-step on a different stream "
+            "would silently break the bitwise replay guarantee")
 
 
 class ManifestCompatWarning(UserWarning):
@@ -93,6 +118,13 @@ class CheckpointManager:
         """Replace the manifest meta written by subsequent saves."""
         with self._lock:
             self.meta = dict(meta or {})
+
+    def update_meta(self, patch: Dict[str, Any]) -> None:
+        """Merge ``patch`` into the manifest meta (the guard's per-save
+        data-plane cursor refresh — run-level facts stay, the cursor
+        advances)."""
+        with self._lock:
+            self.meta.update(patch)
 
     # -- paths ---------------------------------------------------------------
     def path_for(self, step: int) -> str:

@@ -15,13 +15,16 @@ Spec grammar (config string or the ``APEX_TPU_FAULTS`` env var)::
 
     entry      := KIND@STEP [ xCOUNT ] [ :ARG ] | seed=N
     KIND       := nan | inf | preempt | loader_stall | collective_fail
-                  | oom | resize
+                  | oom | resize | shard_corrupt | index_missing
                   (aliases: nan_grads -> nan, inf_grads -> inf,
                    sigterm -> preempt)
     STEP       := first step (0-based) the fault is armed at
+                  (index_missing: the dataset-OPEN call index, like
+                  collective_fail counts wrapper calls)
     COUNT      := consecutive steps it stays armed (default 1)
     ARG        := kind-specific float (loader_stall: seconds to stall;
-                  resize: REQUIRED target world size, e.g. resize@40:4)
+                  resize: REQUIRED target world size, e.g. resize@40:4;
+                  shard_corrupt: byte offset to flip, default mid-file)
 
 Fault kinds and their consumers:
 
@@ -58,6 +61,18 @@ Fault kinds and their consumers:
     run back up at ``M`` chips through ``apex_tpu.elastic``'s
     checkpoint reshard.  ``M`` is required and must be a positive
     integer — a resize to nowhere is a spec bug, not a fault.
+  * ``shard_corrupt`` — ``data.sharded.ShardedLoader`` flips one byte
+    (ARG = byte offset; default mid-file) in the IN-MEMORY copy of the
+    shard the scheduled step reads, so the per-shard CRC32 check fails
+    and the typed ``ShardChecksumError`` (naming shard + record
+    offset) surfaces instead of corrupt records reaching training.
+    The on-disk shard is never touched — one-shot like every kind.
+  * ``index_missing`` — ``data.sharded.load_index`` behaves as if
+    ``INDEX.json`` is gone on the scheduled dataset-open call (STEP is
+    the open-call index, as ``collective_fail`` counts wrapper calls),
+    driving the degrade-to-directory-scan path and its typed
+    ``IndexMissingWarning`` — the manifest-loss posture applied to the
+    data plane.
 
 The module imports neither jax nor the package root at import time, so
 instrumented library code (the data loader) can probe for an active
@@ -72,7 +87,7 @@ import time
 from typing import List, Optional, Tuple
 
 KINDS = ("nan", "inf", "preempt", "loader_stall", "collective_fail", "oom",
-         "resize")
+         "resize", "shard_corrupt", "index_missing")
 _ALIASES = {"nan_grads": "nan", "inf_grads": "inf", "sigterm": "preempt"}
 
 _ENTRY = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"

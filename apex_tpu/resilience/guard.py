@@ -54,8 +54,9 @@ from typing import Any, Callable, List, Optional, Tuple
 import numpy as np
 
 from . import faults as _faults
-from .ckpt import (CheckpointManager, ManifestCompatWarning,
-                   WorldSizeMismatchError, META_LAYOUT_KEY, META_PLAN_KEY,
+from .ckpt import (CheckpointManager, DataStreamMismatchError,
+                   ManifestCompatWarning, WorldSizeMismatchError,
+                   META_DATA_KEY, META_LAYOUT_KEY, META_PLAN_KEY,
                    META_WORLD_KEY)
 from ..checkpoint import CheckpointError
 
@@ -442,6 +443,57 @@ class TrainGuard:
         report.resharded_from = saved_world
         return payload
 
+    # -- the data-plane cursor (docs/data.md) --------------------------------
+    @staticmethod
+    def _data_meta(batches) -> Optional[dict]:
+        """The batch source's run-level data facts, when it speaks the
+        seekable protocol (``data.sharded.ShardedLoader`` — a
+        ``data_meta()`` method).  None for synthetic callables and
+        plain iterators: the manifest simply carries no data block, as
+        before."""
+        meta_fn = getattr(batches, "data_meta", None)
+        if not callable(meta_fn):
+            return None
+        try:
+            meta = meta_fn()
+        except Exception:   # a broken probe must not kill the run
+            return None
+        return meta if isinstance(meta, dict) else None
+
+    def _record_cursor(self, batches, step: int) -> None:
+        """Refresh the manifest's data-plane block with the cursor at
+        ``step`` — pure host arithmetic on the loader's index, merged
+        under the manager lock, so every manifest write names the
+        stream position its newest checkpoint resumes at."""
+        if self.manager is None:
+            return
+        cursor_fn = getattr(batches, "cursor", None)
+        meta = self._data_meta(batches)
+        if meta is None or not callable(cursor_fn):
+            return
+        try:
+            meta = {**meta, "cursor": cursor_fn(int(step))}
+        except Exception:
+            return
+        self.manager.update_meta({META_DATA_KEY: meta})
+
+    @staticmethod
+    def _check_data_stream(batches, saved_meta: dict) -> None:
+        """A manifest that names a dataset index digest must be resumed
+        against the SAME dataset: a digest mismatch raises the typed
+        :class:`DataStreamMismatchError` instead of silently seeking a
+        different stream.  Manifests without a data block (synthetic
+        sources, older versions) pass through untouched."""
+        saved = saved_meta.get(META_DATA_KEY)
+        if not isinstance(saved, dict) or not saved.get("index_digest"):
+            return
+        live = TrainGuard._data_meta(batches)
+        if live is None or not live.get("index_digest"):
+            return   # source can't prove identity: degrade like before
+        if str(live["index_digest"]) != str(saved["index_digest"]):
+            raise DataStreamMismatchError(saved["index_digest"],
+                                          live["index_digest"])
+
     # -- signals -------------------------------------------------------------
     def _install_handlers(self):
         if threading.current_thread() is not threading.main_thread():
@@ -520,6 +572,9 @@ class TrainGuard:
                 meta[META_WORLD_KEY] = int(live_world)
             if cfg.ckpt_meta:
                 meta.update(cfg.ckpt_meta)
+            data_meta = self._data_meta(batches)
+            if data_meta is not None:
+                meta[META_DATA_KEY] = data_meta
             if meta:
                 mgr.set_meta(meta)
 
@@ -527,11 +582,18 @@ class TrainGuard:
             found = mgr.load_latest(with_meta=True)
             if found is not None and found[0] > start_step:
                 ck_step, payload, saved_meta = found
+                # the data stream must be the SAME one the manifest
+                # cursor names — seeking a changed dataset would
+                # silently void the bitwise replay guarantee
+                self._check_data_stream(batches, saved_meta)
                 payload = self._maybe_reshard(state, payload, saved_meta,
                                               live_world, report)
                 with _trace.span("ckpt.restore", step=found[0]):
                     state = self._restore(state, payload)
                 step = min(ck_step, num_steps)
+                seek = getattr(batches, "seek", None)
+                if seekable and callable(seek):
+                    seek(step)     # position any prefetch iteration too
                 report.resumed_from = ck_step
                 self._emit("resumed", step=ck_step)
                 if plan is not None:
@@ -557,6 +619,7 @@ class TrainGuard:
             if mgr is not None and step < num_steps:
                 # rollback anchor: escalation before the first cadence
                 # save must still have somewhere to go
+                self._record_cursor(batches, step)
                 _observed_save(mgr, step, self._snapshot(state, step),
                                registry=self._registry)
                 report.checkpoints += 1
@@ -623,12 +686,14 @@ class TrainGuard:
                                and time.monotonic() - t_last_save
                                >= cfg.save_every_seconds))
                     if due and step < num_steps:
+                        self._record_cursor(batches, step)
                         writer.submit(step, self._snapshot(state, step))
                         report.checkpoints += 1
                         last_saved = step
                         t_last_save = time.monotonic()
             if mgr is not None and (self._stop or cfg.save_on_exit):
                 writer.drain()
+                self._record_cursor(batches, step)
                 _observed_save(mgr, step, self._snapshot(state, step),
                                registry=self._registry)
                 report.checkpoints += 1

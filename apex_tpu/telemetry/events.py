@@ -186,6 +186,45 @@ def record_loader(depth: Optional[int], wait_seconds: float) -> None:
         reg.histogram("loader.depth_samples").observe(depth)
 
 
+def record_loader_retry(batch_index: int, attempt: int, waited_s: float,
+                        next_wait_s: float) -> None:
+    """One bounded-retry attempt inside the loader's timed wait
+    (docs/data.md stall hardening): the consumer saw an empty queue for
+    a full wait window and is waiting again with a doubled budget
+    instead of escalating yet.  ``loader.retry`` event + ``loader.
+    retries`` counter; retries exhausted still raise the typed
+    ``LoaderStallError``, so the event stream tells a healed hiccup
+    from a real wedge."""
+    _trace.note_event("loader.retry", step=int(batch_index),
+                      fields={"attempt": int(attempt),
+                              "waited_ms": waited_s * 1e3,
+                              "next_wait_ms": next_wait_s * 1e3})
+    if not active():
+        return
+    reg = _default
+    reg.counter("loader.retries").add(1)
+    reg.event("loader.retry", batch=int(batch_index), attempt=int(attempt),
+              waited_ms=waited_s * 1e3, next_wait_ms=next_wait_s * 1e3)
+
+
+def record_shard_checksum(shard: str, offset=None) -> None:
+    """A shard failed its CRC32 check (``data.sharded`` — bit rot or an
+    injected ``shard_corrupt`` fault): ``data.checksum_failed`` event +
+    counter, emitted just before the typed ``ShardChecksumError``
+    propagates so the failure is visible in the JSONL even when the
+    run dies on it.  ``offset`` is the record offset within the shard
+    the failing read wanted (None for a whole-shard verify sweep)."""
+    fields = {"shard": str(shard)}
+    if offset is not None:
+        fields["offset"] = int(offset)
+    _trace.note_event("data.checksum_failed", fields=fields)
+    if not active():
+        return
+    reg = _default
+    reg.counter("data.checksum_failures").add(1)
+    reg.event("data.checksum_failed", **fields)
+
+
 def record_update_sharding(state_bytes_per_replica: int,
                            world: int) -> None:
     """Weight-update-sharding gauges (``parallel.weight_update``):

@@ -132,6 +132,15 @@ def summarize(records: List[dict]) -> dict:
         # on the same resilience line
         "reshards": len(events.get("elastic.reshard", ())),
         "replans": len(events.get("elastic.replan", ())),
+        # data plane (docs/data.md): loader stall retries that healed
+        # (or preceded an escalation), shard-checksum failures, and
+        # elastic N->M shard re-partitions — the seekable data plane's
+        # recovery history on the same resilience line
+        "loader_retries": len(events.get("loader.retry", ())),
+        "shard_checksum_failures": len(
+            events.get("data.checksum_failed", ())),
+        "data_repartitions": len(
+            events.get("elastic.data_repartition", ())),
         # memory (docs/telemetry.md Memory): live allocator high-water
         # from the monitor's mem.* gauges (max over the run — a gauge's
         # last value would under-report a mid-run spike), the
@@ -188,7 +197,9 @@ def format_summary(s: dict) -> str:
     res = [(k, s.get(k, 0)) for k in ("faults_injected", "rollbacks",
                                       "resumes", "preemptions",
                                       "sentinel_fires", "reshards",
-                                      "replans")]
+                                      "replans", "loader_retries",
+                                      "shard_checksum_failures",
+                                      "data_repartitions")]
     if any(n for _, n in res):
         lines.append("  resilience          "
                      + "  ".join(f"{k.replace('_', ' ')} {n}"

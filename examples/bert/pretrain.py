@@ -43,6 +43,14 @@ from apex_tpu.utils.logging import AverageMeter, Throughput
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="apex_tpu BERT pretrain example")
     p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--data", default=None,
+                   help="dir of .npz token shards (a 'tokens' int32 "
+                        "array, rows >= --seq-len wide) fed through the "
+                        "seekable shard-addressed loader (apex_tpu.data."
+                        "sharded): checksummed shards, bitwise "
+                        "seek-to-step — with --auto-resume the manifest "
+                        "records the data-plane cursor; default: "
+                        "synthetic MLM batches")
     p.add_argument("--batch-size", type=int, default=8, help="global batch")
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--layers", type=int, default=2)
@@ -93,6 +101,41 @@ def synthetic_mlm(rng, batch, seq, vocab):
     tokens[mask] = 0                      # [MASK]
     weights = mask.astype(np.float32)
     return tokens, targets, weights
+
+
+def _mask_mlm(tokens, seed, step_idx):
+    """MLM masking pure in ``(seed, step)`` — applied to REAL token
+    shards so resume/rollback replay the exact masked batch for any
+    global step (the same seeding contract as ``batch_at``)."""
+    rs = np.random.RandomState((seed * 1000003 + step_idx) % (2 ** 31 - 1))
+    targets = tokens.copy()
+    mask = rs.rand(*tokens.shape) < 0.15
+    tokens = tokens.copy()
+    tokens[mask] = 0                      # [MASK]
+    return {"tokens": tokens, "targets": targets,
+            "weights": mask.astype(np.float32)}
+
+
+def sharded_mlm_loader(args, steps):
+    """Seekable shard-addressed MLM loader over ``--data``'s ``.npz``
+    token shards (``apex_tpu.data.sharded``): checksummed shards, pure
+    addressing, deterministic per-step masking — ``loader(step)``
+    replays bitwise, which is what ``--auto-resume``'s manifest cursor
+    and the elastic resize guarantee need (docs/data.md)."""
+    from apex_tpu.data import ShardedLoader, open_dataset
+
+    def tf(b, step_idx):
+        toks = b["tokens"]
+        if toks.shape[1] < args.seq_len:
+            raise ValueError(
+                f"token shards are {toks.shape[1]} wide < --seq-len "
+                f"{args.seq_len}")
+        return _mask_mlm(toks[:, :args.seq_len].astype(np.int32),
+                         args.seed, step_idx)
+
+    return ShardedLoader(open_dataset(args.data),
+                         global_batch=args.batch_size, seed=args.seed,
+                         num_steps=steps, transform=tf)
 
 
 def run_standard(args, cfg, mesh):
@@ -233,16 +276,23 @@ def main(argv=None):
                              "(the ZeRO holder carry is not a pure pytree)")
         from apex_tpu.resilience import GuardConfig, TrainGuard
 
-        def batch_at(step_idx):
-            # per-step seeding: resume and rollback replay the exact
-            # batch for any global step (the sequential-rng path below
-            # cannot be re-entered mid-stream)
-            rs = np.random.RandomState(
-                (args.seed * 1000003 + step_idx) % (2 ** 31 - 1))
-            tokens, targets, weights = synthetic_mlm(
-                rs, args.batch_size, args.seq_len, cfg.vocab_size)
-            return {"tokens": tokens, "targets": targets,
-                    "weights": weights}
+        if args.data:
+            # real token shards through the seekable data plane: the
+            # loader IS batches(step), and the guard records its
+            # data-plane cursor (index digest + epoch/shard position)
+            # in the checkpoint manifest
+            batch_at = sharded_mlm_loader(args, args.steps)
+        else:
+            def batch_at(step_idx):
+                # per-step seeding: resume and rollback replay the
+                # exact batch for any global step (the sequential-rng
+                # path below cannot be re-entered mid-stream)
+                rs = np.random.RandomState(
+                    (args.seed * 1000003 + step_idx) % (2 ** 31 - 1))
+                tokens, targets, weights = synthetic_mlm(
+                    rs, args.batch_size, args.seq_len, cfg.vocab_size)
+                return {"tokens": tokens, "targets": targets,
+                        "weights": weights}
 
         def on_check(step_idx, window):
             losses.update(window[-1])
@@ -268,14 +318,20 @@ def main(argv=None):
         print(f"=> done: final loss {losses.val:.4f}")
         return losses.val
 
+    data_it = (iter(sharded_mlm_loader(args, args.steps)) if args.data
+               else None)
     with use_mesh(mesh):
         state, step = (run_zero if args.zero else run_standard)(args, cfg,
                                                                 mesh)
         for i in range(args.steps):
-            tokens, targets, weights = synthetic_mlm(
-                rng, args.batch_size, args.seq_len, cfg.vocab_size)
-            state, loss = step(state, {"tokens": tokens, "targets": targets,
-                                       "weights": weights})
+            if data_it is not None:
+                batch = next(data_it)      # prefetched shard-addressed
+            else:
+                tokens, targets, weights = synthetic_mlm(
+                    rng, args.batch_size, args.seq_len, cfg.vocab_size)
+                batch = {"tokens": tokens, "targets": targets,
+                         "weights": weights}
+            state, loss = step(state, batch)
             if (i + 1) % args.print_freq == 0 or i == args.steps - 1:
                 losses.update(float(loss))
                 rate = tput.tick(args.print_freq * args.batch_size)

@@ -201,21 +201,34 @@ def native_batches(args, batch, steps):
                              seed=args.seed, device_put=False))
 
 
-def npz_batches(data_dir, batch, steps):
-    files = sorted(f for f in os.listdir(data_dir) if f.endswith(".npz"))
-    if not files:
-        raise FileNotFoundError(f"no .npz shards under {data_dir}")
-    n = 0
-    while n < steps:
-        for fn in files:
-            z = np.load(os.path.join(data_dir, fn))
-            images, labels = z["images"], z["labels"]
-            for i in range(0, len(images) - batch + 1, batch):
-                yield (images[i:i + batch].astype(np.float32) / 255.0,
-                       labels[i:i + batch].astype(np.int32))
-                n += 1
-                if n >= steps:
-                    return
+def _has_npz_shards(data_dir):
+    try:
+        return any(f.endswith(".npz") for f in os.listdir(data_dir))
+    except OSError:
+        return False
+
+
+def sharded_npz_loader(args, batch, steps, sharding=None):
+    """Seekable shard-addressed loader (``apex_tpu.data.sharded``) over
+    a directory of ``.npz`` shards with ``images``/``labels`` arrays:
+    checksummed shards, pure (seed, epoch, step) addressing, prefetched
+    iteration.  Calling it — ``loader(step)`` — replays any global
+    step's batch bitwise, which is what lets ``--auto-resume`` record
+    the data-plane cursor in the checkpoint manifest and seek the
+    stream on resume instead of restarting it (docs/data.md)."""
+    from apex_tpu.data import ShardedLoader, open_dataset
+
+    def tf(b, step):
+        x = b["images"]
+        x = (x.astype(np.float32) / 255.0 if x.dtype == np.uint8
+             else x.astype(np.float32))
+        y = b["labels"].astype(np.int32)
+        if sharding is not None:
+            return jax.device_put(x, sharding), jax.device_put(y, sharding)
+        return x, y
+
+    return ShardedLoader(open_dataset(args.data), global_batch=batch,
+                         seed=args.seed, num_steps=steps, transform=tf)
 
 
 def validate(args, cfg, state, bn_state, mesh, batch_sharding):
@@ -327,13 +340,20 @@ def main(argv=None):
                              "the rotating checkpoint directory)")
         from apex_tpu.resilience import GuardConfig, TrainGuard
 
-        if args.data or args.loader == "native":
-            # non-seekable sources: resume continues from the iterator's
-            # current position; rollback is unavailable (the guard aborts
-            # with a clear error if it would be needed)
-            src = (native_batches(args, args.batch_size, total_steps)
-                   if args.loader == "native" else
-                   npz_batches(args.data, args.batch_size, total_steps))
+        if args.data and _has_npz_shards(args.data):
+            # the seekable shard-addressed path (docs/data.md): the
+            # loader IS batches(step), so resume and rollback replay
+            # bitwise, and the guard records the data-plane cursor
+            # (epoch/shard position + index digest) in the manifest
+            batch_src = sharded_npz_loader(args, args.batch_size,
+                                           total_steps,
+                                           sharding=batch_sharding)
+        elif args.data or args.loader == "native":
+            # non-seekable sources (memmapped .npy via the native ring):
+            # resume continues from the iterator's current position;
+            # rollback is unavailable (the guard aborts with a clear
+            # error if it would be needed)
+            src = native_batches(args, args.batch_size, total_steps)
             batch_src = ((jax.device_put(x, batch_sharding),
                           jax.device_put(y, batch_sharding))
                          for x, y in src)
@@ -381,7 +401,10 @@ def main(argv=None):
     if args.loader == "native":
         batches = native_batches(args, args.batch_size, total_steps)
     elif args.data:
-        batches = npz_batches(args.data, args.batch_size, total_steps)
+        # shard-addressed loader with prefetch (docs/data.md); same
+        # (x, y) numpy contract as the native path
+        batches = iter(sharded_npz_loader(args, args.batch_size,
+                                          total_steps))
     else:
         batches = synthetic_batches(args.batch_size, args.seed, total_steps)
 

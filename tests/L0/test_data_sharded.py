@@ -1,0 +1,651 @@
+"""apex_tpu.data.sharded (ISSUE 14): the seekable shard-addressed data
+plane that turns TrainGuard's bitwise replay and the elastic N→M resume
+into guarantees that hold on REAL on-disk data.
+
+Covers the tentpole and its acceptance gates:
+
+  * index/checksum format: build/load round trip, digest stability
+    across the index-loss degrade (``IndexMissingWarning``), lazy
+    per-shard CRC verification and the eager ``verify()`` sweep, typed
+    ``ShardChecksumError`` naming shard + offset;
+  * the pure addressing function: per-epoch exact permutations
+    (drop-last), reshuffle across epochs, and the WORLD-INVARIANCE
+    property — concatenating the per-host slices reproduces the global
+    batch bitwise for any host count, including non-divisible shard
+    layouts — which is what makes N→M re-assignment a no-drop/no-dup
+    re-slice;
+  * seek-to-step: ``loader(step)`` is bitwise-identical to sequential
+    iteration across ``(world, resume_step)`` pairs;
+  * new fault kinds: ``shard_corrupt@N`` (typed error, one-shot, event
+    metered, never poisoned training) and ``index_missing`` (degrade to
+    directory scan, manifest-loss posture);
+  * loader stall hardening: bounded retry with exponential backoff
+    (``loader.retry`` events) before the existing typed
+    ``LoaderStallError``;
+  * THE chaos acceptance on the 8-dev CPU mesh: ``preempt@N`` mid-epoch
+    on a real npz-shard dataset resumes via the manifest data cursor
+    and finishes bitwise-identical to an uninterrupted run;
+    ``resize@6:4`` reshards the zero1 optimizer state AND re-partitions
+    the shard assignment, matching a clean 4-way run from the same
+    checkpoint; a changed dataset raises the typed
+    ``DataStreamMismatchError``;
+  * ``report.summarize`` folds ``loader.retry`` / checksum-failure /
+    re-partition events into the resilience line.
+"""
+import functools
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.data import (DatasetError, IndexMissingWarning,
+                           LoaderStallError, ShardChecksumError,
+                           ShardedDataset, ShardedLoader, build_index,
+                           global_records, host_records, load_index,
+                           locate_step, open_dataset)
+from apex_tpu.data import sharded as sharded_mod
+from apex_tpu.resilience import (CheckpointManager, DataStreamMismatchError,
+                                 GuardConfig, TrainGuard, faults)
+from apex_tpu.telemetry import MemorySink, Registry, events
+from apex_tpu.telemetry.report import format_summary, summarize
+
+
+@pytest.fixture(autouse=True)
+def _no_installed_plan():
+    """Fault plans and registries must not leak between tests."""
+    prev = faults.install(None)
+    prev_reg = events.set_default(None)
+    yield
+    faults.install(prev)
+    events.set_default(prev_reg)
+
+
+def _write_shards(d, sizes, *, keys=("x", "y"), seed=0, width=4):
+    """Self-identifying shards: record r's row content encodes r, so
+    every gathered batch proves its own addressing."""
+    n = 0
+    for i, sz in enumerate(sizes):
+        arrs = {}
+        if "x" in keys:
+            arrs["x"] = (np.arange(n, n + sz, dtype=np.float32)[:, None]
+                         * np.ones((1, width), np.float32))
+        if "y" in keys:
+            arrs["y"] = np.arange(n, n + sz, dtype=np.int32)
+        if "tokens" in keys:
+            rng = np.random.RandomState(seed + i)
+            arrs["tokens"] = rng.randint(0, 64, (sz, 20)).astype(np.int32)
+        np.savez(os.path.join(d, f"shard-{i:03d}.npz"), **arrs)
+        n += sz
+    return n
+
+
+# ---------------------------------------------------------------------------
+# index + checksums
+# ---------------------------------------------------------------------------
+
+def test_index_build_load_roundtrip(tmp_path):
+    d = str(tmp_path)
+    n = _write_shards(d, [7, 5, 9])
+    idx = build_index(d)
+    assert idx.n_records == n == 21
+    assert [s.n for s in idx.shards] == [7, 5, 9]
+    assert idx.keys == ("x", "y")
+    idx2 = load_index(d)
+    assert idx2 == idx
+    # the on-disk document carries the digest + counts
+    doc = json.loads((tmp_path / "INDEX.json").read_text())
+    assert doc["digest"] == idx.digest and doc["n_records"] == 21
+
+
+def test_index_missing_degrades_to_scan_with_same_digest(tmp_path):
+    """The manifest-loss posture: a lost index degrades to a directory
+    scan with a typed warning, and the scan recomputes IDENTICAL rows —
+    so the digest (the dataset's identity in the checkpoint manifest)
+    survives the loss and cursor resume still works."""
+    d = str(tmp_path)
+    _write_shards(d, [4, 4])
+    idx = build_index(d)
+    os.unlink(tmp_path / "INDEX.json")
+    with pytest.warns(IndexMissingWarning, match="directory scan"):
+        idx2 = load_index(d)
+    assert idx2.digest == idx.digest
+    assert idx2.shards == idx.shards
+    # open_dataset rebuilds the index file when the dir is writable
+    ds = open_dataset(d)
+    assert os.path.exists(tmp_path / "INDEX.json")
+    assert ds.index.digest == idx.digest
+
+
+def test_index_missing_fault_kind(tmp_path):
+    """``index_missing@K`` fires on the K-th dataset open (one-shot):
+    the scheduled open degrades with the warning, the next one reads
+    the intact index silently."""
+    assert "index_missing" in faults.KINDS
+    d = str(tmp_path)
+    _write_shards(d, [4, 4])
+    idx = build_index(d)
+    base = sharded_mod._OPEN_CALLS["n"]
+    faults.install(faults.parse(f"index_missing@{base}"))
+    with pytest.warns(IndexMissingWarning):
+        idx2 = load_index(d)
+    assert idx2.digest == idx.digest
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")       # consumed: no warning now
+        assert load_index(d).digest == idx.digest
+
+
+def test_lazy_checksum_raises_typed_error_naming_shard_and_offset(tmp_path):
+    d = str(tmp_path)
+    _write_shards(d, [6, 6])
+    ds = ShardedDataset(d, index=build_index(d))
+    # rot a byte in shard 1 on disk
+    p = tmp_path / "shard-001.npz"
+    raw = bytearray(p.read_bytes())
+    raw[len(raw) // 2] ^= 0x01
+    p.write_bytes(bytes(raw))
+    with pytest.raises(ShardChecksumError,
+                       match=r"shard-001\.npz.*record offset 3") as ei:
+        ds.gather(np.asarray([9]))           # record 9 = shard 1, offset 3
+    assert ei.value.shard == "shard-001.npz" and ei.value.offset == 3
+    # the eager sweep names the shard too
+    with pytest.raises(ShardChecksumError, match="shard-001"):
+        ds.verify()
+    # the intact shard still reads fine (corruption is contained)
+    out = ds.gather(np.asarray([2, 5]))
+    np.testing.assert_array_equal(out["y"], [2, 5])
+
+
+def test_verify_sweep_passes_clean_dataset(tmp_path):
+    d = str(tmp_path)
+    _write_shards(d, [5, 5, 5])
+    assert ShardedDataset(d, index=build_index(d)).verify() == 3
+
+
+# ---------------------------------------------------------------------------
+# pure addressing: permutations, drop-last, world invariance
+# ---------------------------------------------------------------------------
+
+def test_epoch_is_exact_permutation_and_reshuffles(tmp_path):
+    d = str(tmp_path)
+    n = _write_shards(d, [13, 14, 13])       # 40 records, gb=8 -> spe=5
+    gb = 8
+    e0 = np.concatenate([global_records(3, s, n, gb) for s in range(5)])
+    e1 = np.concatenate([global_records(3, s, n, gb) for s in range(5, 10)])
+    assert len(set(e0.tolist())) == len(e0) == 40
+    assert sorted(e0.tolist()) == sorted(e1.tolist()) == list(range(40))
+    assert not np.array_equal(e0, e1), "epoch order did not reshuffle"
+    # drop-last: a 41st record never appears with gb=8... (40 % 8 == 0
+    # here, so check the property on a ragged count instead)
+    assert len(global_records(3, 0, 43, gb)) == gb
+
+
+@pytest.mark.parametrize("world", [1, 2, 4, 8])
+def test_host_slices_reassemble_global_batch_bitwise(world, tmp_path):
+    """THE re-partition property: per-host slices concatenate to the
+    world-free global batch, for every world — so resizing N→M re-reads
+    the same records with none dropped and none duplicated."""
+    n, gb = 37 * 3, 8                        # non-divisible shard counts
+    for step in (0, 3, 7, 26):
+        cat = np.concatenate([
+            host_records(5, step, n, gb, world, h) for h in range(world)])
+        np.testing.assert_array_equal(cat, global_records(5, step, n, gb))
+
+
+def test_reassignment_n_to_m_no_drop_no_dup():
+    """N-way and M-way partitions of the same steps cover the same
+    record multiset exactly (incl. grow and non-divisor pairs)."""
+    n, gb = 120 - 7, 24
+    for (a, b) in [(8, 4), (4, 8), (6, 2), (2, 6), (24, 3)]:
+        for step in (0, 2, 4):               # crosses an epoch at spe=4
+            ra = np.concatenate([host_records(9, step, n, gb, a, h)
+                                 for h in range(a)])
+            rb = np.concatenate([host_records(9, step, n, gb, b, h)
+                                 for h in range(b)])
+            np.testing.assert_array_equal(np.sort(ra), np.sort(rb))
+            np.testing.assert_array_equal(ra, rb)   # same ORDER too
+
+
+def test_locate_step_addresses_shard_offsets(tmp_path):
+    d = str(tmp_path)
+    n = _write_shards(d, [7, 5, 9])
+    idx = build_index(d)
+    ds = ShardedDataset(d, index=idx)
+    for world, host in [(1, 0), (3, 1)]:
+        addr = locate_step(idx, 2, 1, 6, world, host)
+        ids = host_records(2, 1, n, 6, world, host)
+        # the addressing and the gather agree record-for-record
+        got = ds.gather(ids)
+        for (si, off), rid, y in zip(addr, ids, got["y"]):
+            assert 0 <= si < 3 and 0 <= off < idx.shards[si].n
+            assert int(y) == int(rid)
+
+
+def test_addressing_validation():
+    with pytest.raises(DatasetError, match="not even one full batch"):
+        global_records(0, 0, 4, 8)
+    with pytest.raises(DatasetError, match="divide over world"):
+        host_records(0, 0, 64, 8, world=3)
+    with pytest.raises(DatasetError, match="host/world"):
+        host_records(0, 0, 64, 8, world=2, host=2)
+
+
+# ---------------------------------------------------------------------------
+# seek-to-step == sequential iteration (bytes-exact)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("world,resume_step", [(1, 0), (1, 7), (2, 3),
+                                               (4, 9), (8, 5)])
+def test_seek_to_step_bitwise_vs_sequential(world, resume_step, tmp_path):
+    """ACCEPTANCE (property): for any (world, resume_step) — including
+    non-divisible shard counts — seeking to a step returns byte-for-
+    byte the batch sequential iteration from step 0 would have
+    delivered there, per host."""
+    d = str(tmp_path)
+    _write_shards(d, [11, 9, 12, 8])         # 40 records, ragged shards
+    idx = build_index(d)
+    for host in range(world):
+        ld = ShardedLoader(ShardedDataset(d, index=idx), global_batch=8,
+                           seed=4, world=world, host=host, num_steps=12)
+        seq = [b for b in iter(ld)]          # sequential, prefetched
+        assert len(seq) == 12
+        for s in range(resume_step, 12):
+            b = ld(s)                        # seek
+            np.testing.assert_array_equal(b["x"], seq[s]["x"])
+            np.testing.assert_array_equal(b["y"], seq[s]["y"])
+            assert b["x"].dtype == seq[s]["x"].dtype
+        # resume via seek(): iteration starts exactly there
+        ld.seek(resume_step)
+        for s, b in zip(range(resume_step, 12), iter(ld)):
+            np.testing.assert_array_equal(b["y"], seq[s]["y"])
+
+
+# ---------------------------------------------------------------------------
+# shard_corrupt fault kind
+# ---------------------------------------------------------------------------
+
+def test_shard_corrupt_fault_typed_error_one_shot(tmp_path):
+    """``shard_corrupt@N``: the shard step N reads fails its CRC with
+    the typed error naming shard + offset; the flip is in-memory and
+    one-shot, so the next read of the same step is clean — corrupt
+    bytes never reach training."""
+    assert "shard_corrupt" in faults.KINDS
+    d = str(tmp_path)
+    _write_shards(d, [10, 10])
+    reg = Registry(sink=MemorySink(), flush_interval=0, rank0_only=False)
+    events.set_default(reg)
+    ld = ShardedLoader(ShardedDataset(d, index=build_index(d)),
+                       global_batch=4, seed=0, num_steps=5,
+                       plan=faults.parse("shard_corrupt@2"))
+    clean = [ld(s) for s in (0, 1)]
+    with pytest.raises(ShardChecksumError, match="record offset") as ei:
+        ld(2)
+    assert ei.value.shard.startswith("shard-")
+    # one-shot: the replay of step 2 is clean and bitwise
+    b2 = ld(2)
+    assert np.isfinite(b2["x"]).all()
+    np.testing.assert_array_equal(ld(0)["x"], clean[0]["x"])
+    # the failure was metered for the resilience line
+    recs = reg.flush()
+    fails = [r for r in recs if r.get("name") == "data.checksum_failed"]
+    assert fails and fails[0]["fields"]["shard"] == ei.value.shard
+    s = summarize(recs)
+    assert s["shard_checksum_failures"] == 1
+    assert "shard checksum failures 1" in format_summary(s)
+
+
+def test_shard_corrupt_surfaces_through_prefetch_iteration(tmp_path):
+    """The fill thread's checksum failure surfaces in the consumer as
+    the same typed error — never a silent hang or poisoned batch."""
+    d = str(tmp_path)
+    _write_shards(d, [10, 10])
+    ld = ShardedLoader(ShardedDataset(d, index=build_index(d)),
+                       global_batch=4, seed=0, num_steps=5,
+                       plan=faults.parse("shard_corrupt@1"))
+    it = iter(ld)
+    next(it)
+    with pytest.raises(ShardChecksumError):
+        next(it)
+
+
+def test_fault_grammar_rows():
+    p = faults.parse("shard_corrupt@3:17;index_missing@0")
+    assert [s.kind for s in p.specs] == ["shard_corrupt", "index_missing"]
+    assert p.specs[0].arg == 17.0
+
+
+# ---------------------------------------------------------------------------
+# loader stall hardening: bounded retry + backoff
+# ---------------------------------------------------------------------------
+
+def test_stall_retries_heal_a_transient_hiccup(tmp_path):
+    """A fill that overruns one wait window but lands within the retry
+    budget delivers the batch (metered as loader.retry events) instead
+    of killing the run."""
+    d = str(tmp_path)
+    _write_shards(d, [8, 8])
+    reg = Registry(sink=MemorySink(), flush_interval=0, rank0_only=False)
+    events.set_default(reg)
+    slow = {"done": False}
+
+    def tf(b, s):
+        if s == 0 and not slow["done"]:
+            slow["done"] = True
+            time.sleep(0.3)                  # one transient hiccup
+        return b
+
+    ld = ShardedLoader(ShardedDataset(d, index=build_index(d)),
+                       global_batch=4, seed=0, num_steps=3, transform=tf,
+                       wait_timeout=0.05, stall_retries=5)
+    got = list(iter(ld))
+    assert len(got) == 3
+    recs = reg.flush()
+    retries = [r for r in recs if r.get("name") == "loader.retry"]
+    assert retries and retries[0]["fields"]["attempt"] == 1
+    s = summarize(recs)
+    assert s["loader_retries"] >= 1
+    assert "loader retries" in format_summary(s)
+
+
+def test_stall_retries_exhausted_still_typed_error(tmp_path):
+    """A real wedge exhausts the backoff budget and raises the SAME
+    typed LoaderStallError as before — current semantics preserved."""
+    d = str(tmp_path)
+    _write_shards(d, [8, 8])
+
+    def tf(b, s):
+        time.sleep(30)                       # wedged fill
+        return b
+
+    ld = ShardedLoader(ShardedDataset(d, index=build_index(d)),
+                       global_batch=4, seed=0, num_steps=2, transform=tf,
+                       wait_timeout=0.05, stall_retries=2)
+    t0 = time.perf_counter()
+    with pytest.raises(LoaderStallError, match="no batch within"):
+        next(iter(ld))
+    # the budget really backed off: 0.05 + 0.05 + 0.1 before raising
+    assert time.perf_counter() - t0 >= 0.2
+
+
+def test_native_loader_retry_path(monkeypatch):
+    """The same retry discipline guards NativeLoader's python ring."""
+    from apex_tpu.data import NativeLoader, SyntheticSource
+    from apex_tpu.data import loader as L
+    monkeypatch.setattr(L, "_load", lambda: None)
+    reg = Registry(sink=MemorySink(), flush_interval=0, rank0_only=False)
+    events.set_default(reg)
+    loader = NativeLoader(SyntheticSource(shape=(4,), n_classes=10),
+                          batch_size=2, steps=2, device_put=False,
+                          wait_timeout=0.05, stall_retries=2)
+    monkeypatch.setattr(L, "_put_checking_stop",
+                        lambda q, item, stop: time.sleep(10))  # wedged
+    with pytest.raises(LoaderStallError, match="no batch within"):
+        next(iter(loader))
+    assert [r for r in reg.flush() if r.get("name") == "loader.retry"]
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: preempt mid-epoch on real data, manifest cursor
+# ---------------------------------------------------------------------------
+
+def _sgd_step():
+    @jax.jit
+    def step(w, batch):
+        g = jax.grad(lambda w: jnp.sum((w - jnp.mean(batch, 0)) ** 2))(w)
+        return w - 0.1 * g, jnp.sum((w - jnp.mean(batch, 0)) ** 2)
+    return step
+
+
+def _img_loader(d, steps, seed=1):
+    return ShardedLoader(
+        ShardedDataset(d), global_batch=8, seed=seed, num_steps=steps,
+        transform=lambda b, s: jnp.asarray(b["x"]))
+
+
+def _cfg(p, **kw):
+    base = dict(ckpt_dir=str(p), save_every_steps=5, check_every=5,
+                backoff_seconds=0.01, enabled=True)
+    base.update(kw)
+    return GuardConfig(**base)
+
+
+def test_chaos_preempt_on_real_data_resumes_bitwise(tmp_path):
+    """ACCEPTANCE: preempt@N mid-epoch on a real npz-shard dataset —
+    the manifest records the data cursor, the rerun seeks the stream,
+    and the final params are BITWISE an uninterrupted run's."""
+    d = tmp_path / "data"
+    d.mkdir()
+    _write_shards(str(d), [13, 14, 13])      # 40 records -> spe=5
+    build_index(str(d))
+    ld = _img_loader(str(d), 20)
+    ref, rep = TrainGuard(_sgd_step(), _cfg(tmp_path / "ref")).run(
+        jnp.zeros(4), ld, 20)
+    assert rep.status == "completed"
+
+    plan = faults.parse("preempt@7")         # step 7 = epoch 1, mid-epoch
+    ck = tmp_path / "chaos"
+    _, r1 = TrainGuard(_sgd_step(), _cfg(ck), plan=plan).run(
+        jnp.zeros(4), ld, 20)
+    assert r1.status == "preempted" and r1.final_step == 7
+
+    # the manifest carries the data-plane cursor at the snapshot step
+    meta = CheckpointManager(str(ck)).manifest_meta()
+    cur = meta["data"]["cursor"]
+    assert cur["step"] == 7 and cur["epoch"] == 1 and cur["epoch_step"] == 2
+    assert meta["data"]["index_digest"] == ld.index_digest
+    assert "shard" in cur and isinstance(cur["shard_offset"], int)
+
+    w2, r2 = TrainGuard(_sgd_step(), _cfg(ck), plan=plan).run(
+        jnp.zeros(4), ld, 20)
+    assert r2.status == "completed" and r2.resumed_from == 7
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(w2))
+
+
+def test_changed_dataset_raises_typed_mismatch(tmp_path):
+    """Resuming a manifest cursor against a DIFFERENT dataset is the
+    loud typed DataStreamMismatchError, never a silent wrong-stream
+    seek."""
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(), d2.mkdir()
+    _write_shards(str(d1), [20, 20])
+    _write_shards(str(d2), [20, 20], seed=9)
+    # different content -> different digest (y differs? x/y identical by
+    # construction — perturb d2)
+    p = d2 / "shard-000.npz"
+    with np.load(p) as z0:
+        z = {k: z0[k] for k in z0.files}
+    z["x"] = z["x"] + 1.0
+    np.savez(p, **z)
+    build_index(str(d1)), build_index(str(d2))
+    ck = tmp_path / "ck"
+    plan = faults.parse("preempt@6")
+    _, r1 = TrainGuard(_sgd_step(), _cfg(ck), plan=plan).run(
+        jnp.zeros(4), _img_loader(str(d1), 16), 16)
+    assert r1.status == "preempted"
+    with pytest.raises(DataStreamMismatchError, match="dataset changed"):
+        TrainGuard(_sgd_step(), _cfg(ck), plan=plan).run(
+            jnp.zeros(4), _img_loader(str(d2), 16), 16)
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: resize@6:4 on real data (zero1 + elastic + repartition)
+# ---------------------------------------------------------------------------
+
+def _build_zero1_harness(world):
+    """The test_elastic harness shape (zero1 update sharding + int8 EF
+    residuals over the flagship-tiny transformer), fed by REAL token
+    shards instead of a synthetic callable."""
+    from apex_tpu.models import TransformerConfig, transformer_init, \
+        transformer_loss
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import create_mesh
+    from apex_tpu.parallel import weight_update as wu
+    from apex_tpu.parallel.mesh import shard_map
+    from apex_tpu.utils.pallas import has_vma, _to_varying
+
+    mesh = create_mesh({"data": world}, jax.devices()[:world])
+    cfg = TransformerConfig(vocab_size=64, max_len=20, num_layers=1,
+                            d_model=32, num_heads=2, d_ff=64,
+                            dtype=jnp.float32)
+    params0 = transformer_init(jax.random.PRNGKey(0), cfg)
+    su = wu.ShardedUpdate(FusedAdam(lr=1e-2, impl="fused"),
+                          axis_name="data",
+                          collective_scheme="int8_blockscale:min_bytes=0")
+    vma_kw = {} if has_vma() else {"check_vma": False}
+    pspec = jax.tree_util.tree_map(lambda _: P(), params0)
+    sspec = su.state_pspecs(params0, world)
+
+    def grads_of(params, tokens):
+        pv = jax.tree_util.tree_map(
+            lambda p: _to_varying(p, ("data",)), params)
+        return jax.value_and_grad(lambda p: transformer_loss(
+            p, {"tokens": tokens, "targets": tokens}, cfg))(pv)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(pspec,),
+                       out_specs=(sspec, P("data")))
+    def init_s(p):
+        return su.init(p), su.init_residual(p)[None]
+
+    def body(params, state, res, tokens):
+        loss, grads = grads_of(params, tokens)
+        params, state, r2 = su.step(state, grads, params, residual=res[0])
+        return params, state, r2[None], jax.lax.pmean(loss, "data")
+
+    jstep = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, sspec, P("data"), P("data")),
+        out_specs=(pspec, sspec, P("data"), P()), **vma_kw))
+    state0, res0 = jax.jit(init_s)(params0)
+
+    def step_fn(state, batch):
+        params, opt_state, res = state
+        params, opt_state, res, loss = jstep(params, opt_state, res,
+                                             batch)
+        return (params, opt_state, res), loss
+
+    return (params0, state0, res0), step_fn, su.layout_meta(params0, world)
+
+
+def _import_canonical(template_state, payload, saved_world, layout):
+    """Independent canonical-flat import (test_elastic's comparator —
+    inline numpy, no elastic code)."""
+    from jax.sharding import NamedSharding
+    used, tot = int(layout["used"]), int(layout["flat_total"])
+    tmpl_leaves, treedef = jax.tree_util.tree_flatten(template_state)
+    out = []
+    for t, h in zip(tmpl_leaves, payload["leaves"]):
+        h = np.asarray(h)
+        if h.shape == tuple(t.shape):
+            v = h
+        elif h.ndim == 1 and h.shape[0] == tot:
+            v = np.zeros((t.shape[0],), h.dtype)
+            v[:used] = h[:used]
+        elif h.ndim == 2 and h.shape == (saved_world, tot):
+            acc = np.zeros((t.shape[1],), h.dtype)
+            for row in h:
+                r = np.zeros((t.shape[1],), h.dtype)
+                r[:used] = row[:used]
+                acc = acc + r
+            v = np.zeros(tuple(t.shape), h.dtype)
+            v[0] = acc
+        else:
+            raise AssertionError((h.shape, tuple(t.shape)))
+        sh = t.sharding if isinstance(t.sharding, NamedSharding) else None
+        out.append(jax.device_put(v.astype(t.dtype), sh))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def test_chaos_resize_6_to_4_real_data_bitwise(tmp_path):
+    """ACCEPTANCE: resize@6:4 kills the 8-way zero1+int8-EF run
+    mid-epoch on a REAL token-shard dataset; the 4-way elastic resume
+    reshards the optimizer state AND re-partitions the shard
+    assignment (elastic.data_repartition), finishing BITWISE-identical
+    to a clean 4-way run started from the same checkpoint."""
+    import apex_tpu.elastic as elastic
+
+    d = tmp_path / "tokens"
+    d.mkdir()
+    _write_shards(str(d), [13, 14, 13], keys=("tokens",))  # spe=5
+    build_index(str(d))
+    ld = ShardedLoader(ShardedDataset(str(d)), global_batch=8, seed=1,
+                       num_steps=10,
+                       transform=lambda b, s: jnp.asarray(b["tokens"]))
+
+    state8, step8, layout8 = _build_zero1_harness(8)
+    state4, step4, layout4 = _build_zero1_harness(4)
+    ck = tmp_path / "ckpts"
+
+    def gcfg(world, layout):
+        return _cfg(ck, save_every_steps=2, check_every=2,
+                    world_size=world,
+                    ckpt_meta={"plan": {"dp": world}, "layout": layout})
+
+    plan = faults.parse("resize@6:4")
+    _, r1 = TrainGuard(step8, gcfg(8, layout8), plan=plan).run(
+        state8, ld, 10)
+    assert r1.status == "preempted" and r1.final_step == 6
+    assert r1.resize_to == 4
+
+    # manifest: optimizer layout AND data cursor, both present
+    ck_step, payload, meta = CheckpointManager(str(ck)).load_latest(
+        with_meta=True)
+    assert ck_step == 6 and meta["world_size"] == 8
+    assert meta["data"]["index_digest"] == ld.index_digest
+    assert meta["data"]["cursor"]["epoch"] == 1    # mid-epoch kill
+
+    # the clean comparator: independent canonical import, plain 4-way
+    # continuation over the SAME real data stream
+    state_b = _import_canonical(state4, payload, 8, meta["layout"])
+    for i in range(ck_step, 10):
+        state_b, _ = step4(state_b, ld(i))
+
+    # the elastic resume: reshard + data re-partition + continue
+    reg = Registry(sink=MemorySink(), flush_interval=0, rank0_only=False)
+    er = elastic.ElasticResume()
+    state_a, r2 = TrainGuard(step4, gcfg(4, layout4), plan=plan,
+                             registry=reg, elastic=er).run(
+        state4, ld, 10)
+    assert r2.status == "completed" and r2.resumed_from == 6
+    assert r2.resharded_from == 8
+    assert er.last_data is not None and er.last_data["to_world"] == 4
+    assert er.last_data["index_digest"] == ld.index_digest
+
+    for a, b in zip(jax.tree_util.tree_leaves(state_a),
+                    jax.tree_util.tree_leaves(state_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    recs = reg.flush()
+    evs = {r["name"]: r for r in recs if r.get("kind") == "event"}
+    assert evs["elastic.reshard"]["fields"]["to_world"] == 4
+    rp = evs["elastic.data_repartition"]["fields"]
+    assert rp["to_world"] == 4 and rp["records_per_host"] == 2
+    s = summarize(recs)
+    assert s["reshards"] == 1 and s["data_repartitions"] == 1
+    assert "data repartitions 1" in format_summary(s)
+
+
+# ---------------------------------------------------------------------------
+# CI/tooling satellites
+# ---------------------------------------------------------------------------
+
+def test_host_sync_lint_covers_data_plane():
+    """The host-sync lint walks all of apex_tpu/ — the new module must
+    exist, stay UNsanctioned in the lint config (it is pure host code
+    with no business calling device_get), and contain no sync calls."""
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    pkg = os.path.join(os.path.dirname(os.path.dirname(here)), "apex_tpu")
+    path = os.path.join(pkg, "data", "sharded.py")
+    assert os.path.exists(path)
+    lint_src = open(os.path.join(here, "test_host_sync_lint.py")).read()
+    assert "sharded.py" not in lint_src     # not waived out of the lint
+    sync = re.compile(r"\b(device_get|block_until_ready)\s*\(")
+    with open(path) as f:
+        for line in f:
+            assert not sync.search(line), line

@@ -51,6 +51,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_SPMD_CMD": "",
            # and the elastic kill-N-resume-M proof (stage 3b)
            "APEX_WATCH_ELASTIC_CMD": "",
+           # and its real-data twin (stage 3b-real)
+           "APEX_WATCH_ELASTIC_REAL_CMD": "",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
            **env_extra}
@@ -666,6 +668,52 @@ def test_elastic_stage_artifact_and_span(tmp_path):
     assert "elastic proof done rc=1" in log3
     assert not (tmp_path / "ELASTIC_FAIL.json").exists()
     assert not (tmp_path / "ELASTIC_FAIL.json.run").exists()
+
+
+def test_elastic_real_data_stage(tmp_path):
+    """ISSUE 14 satellite: stage 3b-real runs the elastic proof on REAL
+    shard-addressed data — same atomic-artifact / span / skip-when-
+    complete discipline as stage 3b, independently disableable."""
+    fake = json.dumps({"metric": "elastic_proof", "backend": "tpu",
+                       "from_world": 8, "to_world": 4, "bitwise": True,
+                       "real_data": True, "data_cursor_ok": True})
+    marker = tmp_path / "real_calls"
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    }
+    r, log = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_ELASTIC_REAL_CMD":
+            f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    art = json.loads((tmp_path / "ELASTIC_PROOF_REAL_r5.json").read_text())
+    assert art["real_data"] is True and art["data_cursor_ok"] is True
+    assert "elastic real-data proof done rc=0" in log
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.elastic_real" in names
+    # skip-when-complete on the next window
+    r2, _ = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_ELASTIC_REAL_CMD":
+            f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+    # a failed real-data proof leaves no truncated artifact behind
+    r3, log3 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_ELASTIC_REAL_JSON": "REAL_FAIL.json",
+        "APEX_WATCH_ELASTIC_REAL_CMD": "echo '{\"bitwise\":'; false",
+    })
+    assert r3.returncode == 0
+    assert "elastic real-data proof done rc=1" in log3
+    assert not (tmp_path / "REAL_FAIL.json").exists()
+    assert not (tmp_path / "REAL_FAIL.json.run").exists()
 
 
 def test_stage_spans_record_failures_too(tmp_path):

@@ -125,6 +125,16 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--kill-at", type=int, default=6)
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--real-data", action="store_true",
+                    help="feed a REAL on-disk npz shard set through the "
+                         "seekable shard-addressed loader (apex_tpu."
+                         "data.sharded) instead of a synthetic "
+                         "callable: the kill-N-resume-M proof then "
+                         "covers the data plane too — manifest cursor, "
+                         "checksum verify, N->M shard re-partition")
+    ap.add_argument("--data-dir", default=None,
+                    help="existing token-shard dir for --real-data "
+                         "(default: a tiny generated set in a temp dir)")
     args = ap.parse_args(argv)
 
     t0 = time.time()
@@ -160,10 +170,39 @@ def main(argv=None) -> int:
     # the global batch must shard over BOTH worlds
     global_batch = int(np.lcm(from_world, to_world))
 
-    def make_batch(step):
-        rng = np.random.RandomState(1000 + step)
-        return jnp.asarray(
-            rng.randint(0, 64, (global_batch, 20)).astype("int32"))
+    data_meta = {}
+    if args.real_data:
+        # a real shard-addressed dataset: non-divisible shard sizes so
+        # the (shard, offset) addressing is genuinely exercised, enough
+        # records that the kill lands MID-EPOCH (epoch > 0)
+        from apex_tpu.data import ShardedLoader, open_dataset
+        ddir = args.data_dir
+        if ddir is None:
+            ddir = tempfile.mkdtemp(prefix="apex_tpu_shards_")
+            n0 = 0
+            for i, sz in enumerate((global_batch * 2 - 3,
+                                    global_batch + 5,
+                                    global_batch * 2 - 2)):
+                rng = np.random.RandomState(77 + i)
+                np.savez(os.path.join(ddir, f"tokens-{i:03d}.npz"),
+                         tokens=rng.randint(
+                             0, 64, (sz, 20)).astype(np.int32))
+                n0 += sz
+        dataset = open_dataset(ddir)
+        dataset.verify()        # the eager checksum sweep, on record
+        make_batch = ShardedLoader(
+            dataset, global_batch=global_batch, seed=1,
+            num_steps=args.steps,
+            transform=lambda b, s: jnp.asarray(b["tokens"]))
+        data_meta = {"real_data": True, "data_dir": ddir,
+                     "index_digest": dataset.index.digest,
+                     "n_records": dataset.n_records,
+                     "steps_per_epoch": make_batch.steps_per_epoch}
+    else:
+        def make_batch(step):
+            rng = np.random.RandomState(1000 + step)
+            return jnp.asarray(
+                rng.randint(0, 64, (global_batch, 20)).astype("int32"))
 
     def mk_su():
         return wu.ShardedUpdate(
@@ -204,9 +243,21 @@ def main(argv=None) -> int:
     for i in range(ck_step, args.steps):
         state_b, _ = step_m(state_b, make_batch(i))
 
+    er = elastic.ElasticResume()
     state_a, r2 = TrainGuard(step_m, gcfg(to_world, layout_m), plan=plan,
-                             elastic=elastic.ElasticResume()).run(
+                             elastic=er).run(
         state_m, make_batch, args.steps)
+
+    # real-data gate: the manifest carried the data-plane cursor for
+    # THIS dataset, and the elastic resume re-partitioned the shard
+    # assignment alongside the optimizer reshard
+    data_ok = True
+    if args.real_data:
+        mdata = meta.get("data") or {}
+        data_ok = (mdata.get("index_digest") == data_meta["index_digest"]
+                   and isinstance(mdata.get("cursor"), dict)
+                   and er.last_data is not None
+                   and er.last_data["to_world"] == to_world)
 
     bitwise = all(
         np.array_equal(np.asarray(a), np.asarray(b))
@@ -225,8 +276,12 @@ def main(argv=None) -> int:
         "bitwise": bool(bitwise),
         "elapsed_s": round(time.time() - t0, 2),
     }
+    if args.real_data:
+        out.update(data_meta)
+        out["data_cursor_ok"] = bool(data_ok)
+        out["data_repartition"] = er.last_data
     print(json.dumps(out))
-    return 0 if (bitwise and ok_kill and typed_error
+    return 0 if (bitwise and ok_kill and typed_error and data_ok
                  and r2.resharded_from == from_world) else 1
 
 

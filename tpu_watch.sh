@@ -99,6 +99,14 @@ GTRAIN_DONE=${APEX_WATCH_GTRAIN_DONE:-TRAIN_GUARD_DONE}
 ELASTIC_CMD=${APEX_WATCH_ELASTIC_CMD-"python tools/elastic_proof.py"}
 ELASTIC_JSON=${APEX_WATCH_ELASTIC_JSON:-ELASTIC_PROOF_r5.json}
 ELASTIC_TO=${APEX_WATCH_ELASTIC_TO:-400}
+# stage 3b-real: the SAME kill-N-resume-M proof on a REAL on-disk npz
+# shard set through the seekable shard-addressed data plane (ISSUE 14)
+# — manifest data cursor + checksum sweep + N->M shard re-partition all
+# on silicon, not just the synthetic callable.  ${VAR-default}: an
+# explicitly EMPTY override disables the stage
+ELASTIC_REAL_CMD=${APEX_WATCH_ELASTIC_REAL_CMD-"python tools/elastic_proof.py --real-data"}
+ELASTIC_REAL_JSON=${APEX_WATCH_ELASTIC_REAL_JSON:-ELASTIC_PROOF_REAL_r5.json}
+ELASTIC_REAL_TO=${APEX_WATCH_ELASTIC_REAL_TO:-400}
 # stage 2b: collective-scheme A/B (fp32 vs bf16/int8/adasum wire bytes +
 # host ms, ISSUE 7) — cheap enough for a short window, and the artifact
 # feeds apply_perf_results' ddp_collective_scheme decision
@@ -387,6 +395,21 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$ELASTIC_JSON".run
       fi
       echo "$(date +%H:%M:%S) elastic proof done rc=$rce" >> "$LOG"
+    fi
+    # ---- stage 3b-real: elastic proof on REAL shard-addressed data ----
+    if [ -n "$ELASTIC_REAL_CMD" ] && [ ! -s "$ELASTIC_REAL_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$ELASTIC_REAL_TO" bash -c "$ELASTIC_REAL_CMD" > "$ELASTIC_REAL_JSON".run 2>> "$LOG"
+      rcer=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span elastic_real "$t0" "$rcer"
+      stage_mem
+      if [ $rcer -eq 0 ] && [ -s "$ELASTIC_REAL_JSON".run ]; then
+        mv "$ELASTIC_REAL_JSON".run "$ELASTIC_REAL_JSON"
+      else
+        # a wedged/failed proof never leaves a truncated artifact behind
+        rm -f "$ELASTIC_REAL_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) elastic real-data proof done rc=$rcer" >> "$LOG"
     fi
     # ---- stage 3: training run with save/resume (numerics proof) ----
     # AFTER the incremental bench stages: an all-or-nothing TRAIN_TO-long
