@@ -74,6 +74,11 @@ Fault kinds and their consumers:
     ``IndexMissingWarning`` — the manifest-loss posture applied to the
     data plane.
 
+Every kind above also declares the goodput-ledger badput class its
+injection is expected to land in (``telemetry.goodput.FAULT_BADPUT``;
+run-terminating kinds map to ``"abort"``) — completeness-tested, so a
+new KINDS entry without a ledger mapping fails tier-1.
+
 The module imports neither jax nor the package root at import time, so
 instrumented library code (the data loader) can probe for an active
 plan at near-zero cost.

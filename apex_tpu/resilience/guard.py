@@ -170,6 +170,12 @@ class GuardReport:
     #: the resume crossed a chip-count change and the checkpoint was
     #: resharded (saved world -> live world)
     resharded_from: Optional[int] = None
+    #: the run-level goodput ledger doc (``telemetry.goodput``: every
+    #: wall-clock second attributed to exactly one class) and the
+    #: ``GOODPUT.json`` path it was written to — None when no tracer
+    #: was active (the ledger streams off the default tracer's spans)
+    goodput: Optional[dict] = None
+    goodput_path: Optional[str] = None
 
 
 def _observed_save(manager: CheckpointManager, step: int, payload,
@@ -359,6 +365,54 @@ class TrainGuard:
                                   directory=directory, registry=reg)
         except Exception:
             return None
+
+    def _blocked_ckpt(self, step: int, fn):
+        """Run a checkpoint operation the STEP LOOP waits on — a writer
+        drain/submit or an inline anchor/exit save — inside a
+        ``ckpt.exposed`` span + ``ckpt.exposed_ms`` meter
+        (docs/telemetry.md Goodput ledger).  Only this boundary-blocked
+        time charges the run's wall-clock ledger; the background
+        writer's own ``ckpt.write`` duration is overlapped by design
+        and stays out of the accounting, so a fully-overlapped
+        background save contributes ~0 exposed ms."""
+        from ..telemetry import events as _tel_events
+        from ..telemetry import trace as _trace
+        t0 = time.perf_counter()
+        try:
+            return fn()
+        finally:
+            dur = time.perf_counter() - t0
+            _trace.note_span("ckpt.exposed", dur, step=step)
+            _tel_events.record_ckpt_exposed(dur, reg=self._registry,
+                                            step=step)
+
+    def _finalize_goodput(self, ledger, tracer, prev_ledger, report):
+        """Close out the run's goodput ledger (best-effort —
+        observability must never mask the real error propagating
+        through ``run()``): detach it from the tracer, restore the
+        previously-installed process ledger, export the final
+        ``goodput.fraction``/``badput.*`` gauges, and write the
+        schema-valid ``GOODPUT.json`` run artifact on the
+        flight-recorder destination chain — exit, preempt and crash
+        all leave the artifact."""
+        from ..telemetry import events as _tel_events
+        from ..telemetry import goodput as _goodput
+        ledger.detach(tracer)
+        _goodput.install(prev_ledger)
+        try:
+            doc = ledger.snapshot(status=report.status)
+            report.goodput = doc
+            reg = self._registry
+            if reg is None:
+                reg = _tel_events.get_default()
+            ledger.observe(reg, doc=doc)
+            directory = self._flight_destination(
+                tracer.recorder.directory if tracer is not None else None)
+            if directory is not None:
+                report.goodput_path = ledger.write(directory=directory,
+                                                   doc=doc)
+        except Exception:   # disk full / off-schema doc: the run's
+            pass            # outcome must still propagate untouched
 
     # -- state <-> host ------------------------------------------------------
     def _snapshot(self, state, step: int) -> dict:
@@ -563,6 +617,8 @@ class TrainGuard:
         mgr = self.manager
         step = start_step
 
+        from ..telemetry import events as _tel_events
+        from ..telemetry import goodput as _goodput
         from ..telemetry import trace as _trace
 
         live_world = cfg.world_size or _infer_world(state)
@@ -578,31 +634,6 @@ class TrainGuard:
             if meta:
                 mgr.set_meta(meta)
 
-        if mgr is not None and cfg.auto_resume:
-            found = mgr.load_latest(with_meta=True)
-            if found is not None and found[0] > start_step:
-                ck_step, payload, saved_meta = found
-                # the data stream must be the SAME one the manifest
-                # cursor names — seeking a changed dataset would
-                # silently void the bitwise replay guarantee
-                self._check_data_stream(batches, saved_meta)
-                payload = self._maybe_reshard(state, payload, saved_meta,
-                                              live_world, report)
-                with _trace.span("ckpt.restore", step=found[0]):
-                    state = self._restore(state, payload)
-                step = min(ck_step, num_steps)
-                seek = getattr(batches, "seek", None)
-                if seekable and callable(seek):
-                    seek(step)     # position any prefetch iteration too
-                report.resumed_from = ck_step
-                self._emit("resumed", step=ck_step)
-                if plan is not None:
-                    # faults scheduled before the resume point already
-                    # happened in the interrupted run; a re-armed env
-                    # plan must not re-fire them (a re-firing preempt
-                    # would wedge the run in a preempt/resume loop)
-                    plan.skip_until(step)
-
         self._stop = False
         prev_handlers = self._install_handlers()
         writer = (_AsyncWriter(mgr, registry=self._registry)
@@ -613,15 +644,63 @@ class TrainGuard:
         self._streak = 0
         self._floor_checks = 0
         self._last_bad_step: Optional[int] = None
-        last_saved = step
-        t_last_save = time.monotonic()
+        # the run-level goodput ledger (docs/telemetry.md Goodput
+        # ledger): one per run, streaming off the default tracer's
+        # spans/events, installed as the process ledger so every
+        # Registry.flush exports live goodput.fraction / badput.*
+        # gauges through its batched window.  The jax compilation
+        # meter registers alongside (idempotent, one prefix check per
+        # monitoring event) so a shape-churn retrace lands in the
+        # ledger's recompile class instead of inflating "step time".
+        # Finalized — gauges + GOODPUT.json on the flight destination
+        # chain — in the finally below, so exit, preempt AND crash all
+        # leave the run artifact.  No tracer (or a disabled one) means
+        # no ledger: zero extra cost, the subsystem's bar.
+        _tel_events.install_compile_listener()
+        tracer = _trace.get_tracer()
+        ledger = prev_ledger = None
+        if tracer is not None and tracer.enabled:
+            ledger = _goodput.GoodputLedger()
+            ledger.attach(tracer)
+            prev_ledger = _goodput.install(ledger)
         try:
+            if mgr is not None and cfg.auto_resume:
+                found = mgr.load_latest(with_meta=True)
+                if found is not None and found[0] > start_step:
+                    ck_step, payload, saved_meta = found
+                    # the data stream must be the SAME one the manifest
+                    # cursor names — seeking a changed dataset would
+                    # silently void the bitwise replay guarantee
+                    self._check_data_stream(batches, saved_meta)
+                    payload = self._maybe_reshard(state, payload,
+                                                  saved_meta, live_world,
+                                                  report)
+                    with _trace.span("ckpt.restore", step=found[0]):
+                        state = self._restore(state, payload)
+                    step = min(ck_step, num_steps)
+                    seek = getattr(batches, "seek", None)
+                    if seekable and callable(seek):
+                        seek(step)   # position any prefetch iteration too
+                    report.resumed_from = ck_step
+                    self._emit("resumed", step=ck_step)
+                    if plan is not None:
+                        # faults scheduled before the resume point
+                        # already happened in the interrupted run; a
+                        # re-armed env plan must not re-fire them (a
+                        # re-firing preempt would wedge the run in a
+                        # preempt/resume loop)
+                        plan.skip_until(step)
+            last_saved = step
+            t_last_save = time.monotonic()
             if mgr is not None and step < num_steps:
                 # rollback anchor: escalation before the first cadence
-                # save must still have somewhere to go
+                # save must still have somewhere to go.  Inline (the
+                # writer thread is idle this early), so the whole save
+                # is boundary-blocked — metered as such
                 self._record_cursor(batches, step)
-                _observed_save(mgr, step, self._snapshot(state, step),
-                               registry=self._registry)
+                self._blocked_ckpt(step, lambda: _observed_save(
+                    mgr, step, self._snapshot(state, step),
+                    registry=self._registry))
                 report.checkpoints += 1
             while step < num_steps:
                 if plan is not None and not self._stop:
@@ -653,7 +732,11 @@ class TrainGuard:
                     self._emit("fault_injected", kind="oom", step=step)
                     from ..telemetry import memory as _tmem
                     raise _tmem.synthetic_oom(step)
-                batch = batches(step) if seekable else next(it)
+                # the ledger's data_stall stream: time the step
+                # boundary waits on its batch (a prefetched loader
+                # returns instantly; a stalled one shows here)
+                with _trace.span("data.fetch", step=step):
+                    batch = batches(step) if seekable else next(it)
                 if plan is not None:
                     for kind in ("nan", "inf"):
                         if plan.fire(kind, step) is not None:
@@ -661,7 +744,13 @@ class TrainGuard:
                             report.faults_injected += 1
                             self._emit("fault_injected", kind=kind,
                                        step=step)
-                state, loss = split(self.step_fn(state, batch))
+                # the guard owns the loop, so it emits the train.step
+                # span the ledger and the trace CLI decompose against
+                # (Registry.step() emits the same name for loops it
+                # wraps — the ledger unions overlaps, never counts
+                # the same wall-clock twice)
+                with _trace.span("train.step", step=step):
+                    state, loss = split(self.step_fn(state, batch))
                 if loss is not None:
                     pending.append((step, loss))
                 step += 1
@@ -674,8 +763,8 @@ class TrainGuard:
                 pending.clear()             # window consumed either way
                 since_check = 0
                 if not healthy:
-                    if writer is not None:
-                        writer.drain()      # newest ckpt must be on disk
+                    if writer is not None:  # newest ckpt must be on disk
+                        self._blocked_ckpt(step, writer.drain)
                     state, step = self._rollback(state, report, seekable)
                     last_saved = min(last_saved, step)
                     continue
@@ -687,15 +776,21 @@ class TrainGuard:
                                >= cfg.save_every_seconds))
                     if due and step < num_steps:
                         self._record_cursor(batches, step)
-                        writer.submit(step, self._snapshot(state, step))
+                        # the snapshot host read + the (rarely blocking)
+                        # queue hand-off is the boundary's whole exposed
+                        # cost — the pickle+write overlaps off-thread
+                        self._blocked_ckpt(
+                            step, lambda: writer.submit(
+                                step, self._snapshot(state, step)))
                         report.checkpoints += 1
                         last_saved = step
                         t_last_save = time.monotonic()
             if mgr is not None and (self._stop or cfg.save_on_exit):
-                writer.drain()
+                self._blocked_ckpt(step, writer.drain)
                 self._record_cursor(batches, step)
-                _observed_save(mgr, step, self._snapshot(state, step),
-                               registry=self._registry)
+                self._blocked_ckpt(step, lambda: _observed_save(
+                    mgr, step, self._snapshot(state, step),
+                    registry=self._registry))
                 report.checkpoints += 1
             if self._stop:
                 report.status = "preempted"
@@ -703,7 +798,7 @@ class TrainGuard:
                 self._dump_flight("preempt", step)
             report.final_step = step
             if writer is not None:
-                writer.drain()
+                self._blocked_ckpt(step, writer.drain)
             return state, report
         except BaseException as e:
             # the crash flight recorder: whatever ran in the seconds
@@ -713,6 +808,8 @@ class TrainGuard:
             # allocator report + live-memory history + static
             # attribution — instead of the generic dump
             from ..telemetry import memory as _tmem
+            report.status = "crashed"   # the honest status the goodput
+            # artifact records (the report itself never returns here)
             if _tmem.is_oom_error(e):
                 self._emit("memory.oom", step=step, error=repr(e)[:200])
                 self._dump_oom(step, e)
@@ -724,6 +821,9 @@ class TrainGuard:
             if writer is not None:
                 writer.close()
             self._restore_handlers(prev_handlers)
+            if ledger is not None:
+                self._finalize_goodput(ledger, tracer, prev_ledger,
+                                       report)
 
     # -- health + rollback ---------------------------------------------------
     def _health_check(self, state, pending) -> bool:
@@ -793,5 +893,9 @@ class TrainGuard:
                           attempt=report.rollbacks, to_step=ck_step,
                           bad_step=self._last_bad_step)
         self._last_bad_step = None     # consumed by this dump
-        time.sleep(cfg.backoff_seconds * (2 ** (report.rollbacks - 1)))
+        # the backoff sleep is part of the rollback's cost — the ledger
+        # charges it to restore_replay, not idle
+        with _trace.span("guard.backoff", step=ck_step,
+                         attempt=report.rollbacks):
+            time.sleep(cfg.backoff_seconds * (2 ** (report.rollbacks - 1)))
         return state, ck_step

@@ -1,6 +1,6 @@
 """apex_tpu.telemetry — training-telemetry subsystem.
 
-Seven pieces (see docs/telemetry.md):
+Eight pieces (see docs/telemetry.md):
 
   * :mod:`registry`  — counters/gauges/histograms/meters with a
     host-sync-batching ``step()`` context, rank-0-gated JSONL emission
@@ -29,10 +29,18 @@ Seven pieces (see docs/telemetry.md):
     events), a correlated host+device Chrome merge, and the measured
     ``exposed_comm_fraction`` that feeds the planner's
     ``overlap_measured_fraction`` tuning key;
+  * :mod:`goodput`   — the run-level goodput ledger: every wall-clock
+    second of a run attributed to exactly one class (productive step
+    compute, exposed collective, data stall, exposed checkpoint save,
+    restore+rollback replay, recompilation, elastic reshard, idle) by
+    exact interval arithmetic over the streams above; exported as
+    ``goodput.fraction``/``badput.*`` gauges through the batched
+    flush and as the ``GOODPUT.json`` run artifact the guard writes on
+    exit/preempt/crash;
   * :mod:`report`    — JSONL → step-metrics summary +
     ``python -m apex_tpu.telemetry`` CLI (``trace <file>`` renders the
     span-timeline summary, ``mem`` the peak-HBM table, ``timeline``
-    the per-device step decomposition).
+    the per-device step decomposition, ``goodput`` the run ledger).
 
 The reference has no counterpart: its observability is rank-0 prints
 and an ``AverageMeter`` whose docstring warns that printing costs an
@@ -47,6 +55,7 @@ from . import registry
 from . import events
 from . import memory
 from . import timeline
+from . import goodput
 from .registry import (SCHEMA, Registry, Counter, Gauge, Histogram,
                        AverageMeter, Throughput, JsonlSink, MemorySink,
                        NULL_METRIC, record_violations, records_violations)
@@ -57,9 +66,11 @@ from .trace import (Tracer, FlightRecorder, SlowStepSentinel, NULL_SPAN,
                     set_tracer, get_tracer, span, traced)
 from .memory import (MemoryMonitor, memory_table, memory_model,
                      format_memory_table)
+from .goodput import GoodputLedger, goodput_violations, FAULT_BADPUT
 
 __all__ = [
-    "trace", "registry", "events", "memory", "timeline", "SCHEMA",
+    "trace", "registry", "events", "memory", "timeline", "goodput",
+    "SCHEMA",
     "Registry",
     "Counter", "Gauge",
     "Histogram", "AverageMeter", "Throughput", "JsonlSink", "MemorySink",
@@ -70,4 +81,5 @@ __all__ = [
     "set_tracer", "get_tracer", "span", "traced",
     "MemoryMonitor", "memory_table", "memory_model",
     "format_memory_table",
+    "GoodputLedger", "goodput_violations", "FAULT_BADPUT",
 ]

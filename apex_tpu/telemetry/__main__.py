@@ -9,7 +9,10 @@ transformer step, a bench artifact's MFU/peak-HBM fields, or a
 ``flight-oom-*.json`` post-mortem); ``python -m apex_tpu.telemetry
 timeline <trace|profiler-dir>`` renders the per-device step
 decomposition (compute / comm / exposed-comm / idle ms + straggler
-skew) from a device trace.  See ``report.main`` for the flags."""
+skew) from a device trace; ``python -m apex_tpu.telemetry goodput
+<jsonl|run-dir>`` renders the run-level goodput ledger (wall-clock
+badput attribution) from a ``GOODPUT.json`` artifact or a run's
+exported gauges.  See ``report.main`` for the flags."""
 from .report import main
 
 if __name__ == "__main__":

@@ -240,6 +240,23 @@ def record_update_sharding(state_bytes_per_replica: int,
     reg.gauge("ddp.update_shard_world").set(float(world))
 
 
+def record_ckpt_exposed(seconds: float, reg=None, step=None) -> None:
+    """Boundary-blocked checkpoint time (docs/telemetry.md Goodput
+    ledger): the wall-clock the STEP LOOP actually waited on checkpoint
+    machinery — writer drains/submits and the inline anchor/exit saves
+    — as opposed to :func:`record_ckpt`'s ``ckpt.write_ms``, which is
+    the background writer's own (overlapped) duration.  ``ckpt.
+    exposed_ms`` gauge carries the last blocking occurrence and the
+    ``ckpt.exposed_ms_total`` counter accumulates the run total, so a
+    fully-overlapped background save provably contributes ~0."""
+    if reg is None:
+        reg = _default
+    if reg is None or not reg.enabled:
+        return
+    reg.gauge("ckpt.exposed_ms").set(seconds * 1e3)
+    reg.counter("ckpt.exposed_ms_total").add(seconds * 1e3)
+
+
 def record_ckpt(seconds: float, nbytes: int, reg=None) -> None:
     """Checkpoint-write meter, called from the guard's BACKGROUND
     writer thread after each ``CheckpointManager.save``: write duration
@@ -254,3 +271,58 @@ def record_ckpt(seconds: float, nbytes: int, reg=None) -> None:
         return
     reg.gauge("ckpt.write_ms").set(seconds * 1e3)
     reg.gauge("ckpt.bytes_written").set(float(nbytes))
+
+
+# -- jax compilation meter (docs/telemetry.md Goodput ledger) -----------------
+# Recompilation is a first-class badput source: a shape-churn retrace
+# silently inflates "step time" unless compile time is metered on its
+# own.  ``jax.monitoring`` publishes per-phase compile durations
+# (`/jax/core/compile/{jaxpr_trace,jaxpr_to_mlir_module,backend_compile}
+# _duration`); the listener turns each into a post-hoc ``compile.<phase>``
+# span through the default tracer (which streams into an attached
+# GoodputLedger as ``recompile`` badput) and accumulates ``compile.ms``
+# / ``compile.count`` counters through the default registry.  The
+# listener registers ONCE per process (jax.monitoring has no unregister
+# short of clearing everyone's listeners) and costs one prefix check
+# per monitoring event; with no registry/tracer installed every hook
+# inside is a single attribute check — the disabled-mode bar.
+
+_COMPILE_EVENT_PREFIX = "/jax/core/compile/"
+_compile_listener_installed = False
+
+
+def _on_compile_event(event, duration_secs, **kw) -> None:
+    if not isinstance(event, str) \
+            or not event.startswith(_COMPILE_EVENT_PREFIX):
+        return
+    phase = event[len(_COMPILE_EVENT_PREFIX):]
+    if phase.endswith("_duration"):
+        phase = phase[: -len("_duration")]
+    # post-hoc span ending now: the listener fires right as the phase
+    # completes, so the interval lands where the compile actually ran
+    _trace.note_span(f"compile.{phase}", float(duration_secs))
+    if not active():
+        return
+    reg = _default
+    reg.counter("compile.ms").add(float(duration_secs) * 1e3)
+    if phase == "backend_compile":
+        # one backend_compile per compilation: the honest compile COUNT
+        # (trace/lowering phases also fire for cache hits and retraces)
+        reg.counter("compile.count").add(1)
+
+
+def install_compile_listener() -> bool:
+    """Register the jax compilation meter (idempotent; returns True
+    when the listener is active).  Import of jax is deferred to here —
+    the tooling layer must never pay backend bring-up."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+    try:
+        import jax.monitoring
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_compile_event)
+    except Exception:   # pragma: no cover - monitoring API unavailable
+        return False
+    _compile_listener_installed = True
+    return True

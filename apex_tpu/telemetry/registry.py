@@ -50,6 +50,11 @@ try:                        # the memory monitor rides the same shim
 except ImportError:                    # audits SCHEMA, never flushes
     _memory = None
 
+try:                        # the goodput ledger too: flush exports the
+    from . import goodput as _goodput  # installed ledger's gauges; the
+except ImportError:                    # standalone load never flushes
+    _goodput = None
+
 # ---------------------------------------------------------------------------
 # record schema (the committed JSONL contract)
 # ---------------------------------------------------------------------------
@@ -437,9 +442,16 @@ class Registry:
 
     def __init__(self, *, sink=None, enabled: Optional[bool] = None,
                  flush_interval: int = 1, rank0_only: bool = True,
-                 run_id: Optional[str] = None, memory=None):
+                 run_id: Optional[str] = None, memory=None, goodput=None):
         self.enabled = _env_enabled() if enabled is None else bool(enabled)
         self.sink = sink
+        # run-level goodput gauges (docs/telemetry.md Goodput ledger):
+        # ``goodput`` pins a telemetry.goodput.GoodputLedger, None
+        # consults the process-installed ledger at each flush (the
+        # guard installs its run ledger there), False switches the
+        # export off.  The ledger's gauges are plain host floats — they
+        # resolve inside the flush's one batched read, adding no sync.
+        self._goodput = goodput
         # live-memory gauges (docs/telemetry.md Memory): ``memory`` is a
         # telemetry.memory.MemoryMonitor, None for the env-gated default
         # (APEX_TPU_TELEMETRY_MEM), or False to switch polling off.  A
@@ -577,6 +589,13 @@ class Registry:
             # read -> mem.* gauges (resolved just below, they are
             # plain floats) + the tracer's device_mem counter track
             self._memory.observe_flush(self)
+        if self._goodput is not False and _goodput is not None:
+            led = (self._goodput if self._goodput is not None
+                   else _goodput.get_ledger())
+            if led is not None and led.enabled:
+                # refresh goodput.fraction / badput.* gauges inside the
+                # same batched window (plain floats, zero extra sync)
+                led.observe_flush(self)
         resolve = self._resolver()
         records: List[dict] = []
         if not self._wrote_meta:

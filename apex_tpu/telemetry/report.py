@@ -149,6 +149,15 @@ def summarize(records: List[dict]) -> dict:
         "mem_peak_bytes": mem_peak,
         "mem_in_use_bytes": gauge_last("mem.bytes_in_use"),
         "oom_events": len(events.get("memory.oom", ())),
+        # goodput (docs/telemetry.md Goodput ledger): the run ledger's
+        # exported gauges — wall-clock fraction that was productive
+        # training, plus the per-class badput breakdown in ms
+        "goodput_fraction": gauge_last("goodput.fraction"),
+        "badput_ms": {
+            name[len("badput."):-len("_ms")]: recs[-1]["value"]
+            for name, recs in metrics.items()
+            if name.startswith("badput.") and name.endswith("_ms")
+            and recs and recs[-1]["type"] == "gauge"},
     }
     examples = counter_final("examples") or counter_final("tokens")
     if examples and step_time and step_time["sum"]:
@@ -213,6 +222,14 @@ def format_summary(s: dict) -> str:
             parts.append(f"in-use {_hb(s['mem_in_use_bytes'], 'B')}")
         parts.append(f"oom events {s.get('oom_events', 0)}")
         lines.append("  memory              " + "  ".join(parts))
+    if s.get("goodput_fraction") is not None:
+        bad = [(k, v) for k, v in sorted((s.get("badput_ms") or {}).items())
+               if v]
+        lines.append(f"  goodput             fraction "
+                     f"{s['goodput_fraction']:.3f}"
+                     + ("  badput: " + "  ".join(
+                         f"{k.replace('_', ' ')} {v:.1f}ms"
+                         for k, v in bad) if bad else ""))
     return "\n".join(lines)
 
 
@@ -322,6 +339,12 @@ def main(argv=None) -> int:
         # comm / idle) + straggler skew from a device trace
         from . import timeline as _timeline
         return _timeline.cli(argv[1:])
+    if argv and argv[0] == "goodput":
+        # `python -m apex_tpu.telemetry goodput <jsonl|run-dir>`: the
+        # run-level goodput ledger table + badput breakdown from a
+        # GOODPUT.json artifact or a run's exported gauges
+        from . import goodput as _goodput
+        return _goodput.cli(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.telemetry",

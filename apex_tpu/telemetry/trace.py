@@ -396,6 +396,11 @@ class SlowStepSentinel:
             decomp = _timeline.summarize(self.profile_dir)
             if not decomp["devices"]:
                 return
+            led = getattr(tr, "ledger", None)
+            if led is not None:
+                # a device capture exists: the goodput ledger can carve
+                # the MEASURED exposed-comm share out of step time
+                led.set_decomposition(decomp)
             tr.recorder.dump(
                 "slow_step_timeline",
                 directory=(self.dump_dir or tr.recorder.directory
@@ -505,6 +510,12 @@ class Tracer:
         self.enabled = _env_enabled() if enabled is None else bool(enabled)
         self.recorder = FlightRecorder(ring, directory=flight_dir)
         self.sentinel = sentinel
+        # run-level goodput ledger hook (telemetry.goodput): when a
+        # GoodputLedger is attached, every completed span/event streams
+        # into its wall-clock accounting LIVE — no dependence on the
+        # bounded flight ring, so a long run's ledger never loses its
+        # early intervals.  One attribute check when detached.
+        self.ledger = None
         self.max_spans = int(max_spans)
         self.process_name = process_name
         self.dropped_spans = 0
@@ -594,6 +605,10 @@ class Tracer:
         self.recorder.record({"kind": "span", "name": name,
                               "t_us": ev["ts"], "dur_us": ev["dur"],
                               "thread": th.name, "attrs": args})
+        led = self.ledger
+        if led is not None:
+            led.note_span(name, ev["ts"], ev["dur"],
+                          step=args.get("step"))
 
     # -- ring-only notes (events / metric flushes from the registry) --------
     def note_event(self, name: str, step: Optional[int] = None,
@@ -603,6 +618,9 @@ class Tracer:
         self.recorder.record({"kind": "event", "name": name,
                               "step": None if step is None else int(step),
                               "fields": _clean_fields(fields)})
+        led = self.ledger
+        if led is not None:
+            led.note_event(name, step=step, fields=fields)
 
     def note_flush(self, step: int, records: List[dict]) -> None:
         if not self.enabled:

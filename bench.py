@@ -1218,6 +1218,66 @@ def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
     return out
 
 
+def bench_goodput(on_tpu, steps=10):
+    """Run-level goodput ledger leg (ISSUE 15): a short, CLEAN
+    ``TrainGuard``-driven flagship-transformer run — checkpoint anchor
+    + cadence saves + exit save, batched health checks — under a
+    pinned tracer, so the real ledger machinery (span streaming,
+    priority partition, ``GOODPUT.json`` artifact) produces on-chip
+    goodput evidence through the watcher's full-bench stage.  The
+    compile is warmed OUTSIDE the run window (a clean run's fraction
+    must reflect steady state, not one-time bring-up; the recompile
+    class is exercised by the chaos tests, not this leg).  The
+    embedded ``goodput`` block is audited by
+    ``apply_perf_results.goodput_violations`` (classes partition the
+    wall exactly, fractions in [0, 1], replay iff restores)."""
+    import tempfile
+
+    from apex_tpu.resilience import GuardConfig, TrainGuard
+    from apex_tpu.telemetry import report as treport
+    from apex_tpu.telemetry import trace as tracemod
+
+    train_step, state, make_batch = treport.demo_step_fn(
+        layers=2, batch=8 if on_tpu else 4, seq=64)
+    boost = jnp.asarray(1.0, jnp.float32)
+
+    def step_fn(st, batch):
+        tokens, targets = batch
+        return train_step(st, tokens, targets, boost)
+
+    _log(f"goodput leg: warming compile, then {steps} guarded steps ...")
+    state, _ = step_fn(state, make_batch(0))     # warm outside the window
+    _sync(state)
+    d = tempfile.mkdtemp(prefix="apex_goodput_")
+    tracer = tracemod.Tracer(enabled=True, flight_dir=d)
+    prev = tracemod.set_tracer(tracer)
+    t0 = time.perf_counter()
+    try:
+        guard = TrainGuard(step_fn, GuardConfig(
+            ckpt_dir=os.path.join(d, "ckpt"),
+            save_every_steps=max(steps // 3, 1), check_every=2,
+            enabled=True))
+        _, rep = guard.run(state, make_batch, steps)
+    finally:
+        tracemod.set_tracer(prev)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    doc = rep.goodput
+    out = {"leg": "goodput", "steps": steps,
+           "wall_ms": round(wall_ms, 3), "status": rep.status,
+           "checkpoints": rep.checkpoints, "artifact": rep.goodput_path,
+           "goodput": doc}
+    if doc is not None:
+        out["goodput_fraction"] = doc["goodput_fraction"]
+        gauges = {"goodput.fraction": doc["goodput_fraction"],
+                  "goodput.wall_ms": doc["wall_ms"]}
+        for cls, row in doc["classes"].items():
+            if cls != "productive":
+                gauges[f"badput.{cls}_ms"] = row["ms"]
+        out["telemetry"] = telemetry_summary([wall_ms / max(steps, 1)],
+                                             gauges=gauges)
+    return out
+
+
 def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     """The bench with optional span tracing: ``APEX_BENCH_TRACE=<path>``
     wraps every leg in a span and writes the Chrome-trace timeline on
@@ -1412,6 +1472,19 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
     else:
         _log("skipping spmd leg (budget)")
     gc.collect()
+    # run-level goodput ledger leg (ISSUE 15): a short guard-driven run
+    # whose GOODPUT ledger lands in the artifact for the
+    # goodput_violations audit and the bench_trend.py watchdog
+    if budget_left() > 45:
+        try:
+            with _leg_span("goodput"):
+                detail["goodput"] = bench_goodput(on_tpu)
+        except Exception as err:
+            detail["goodput"] = {"error": repr(err)[:200]}
+        flush("goodput", detail["goodput"])
+    else:
+        _log("skipping goodput leg (budget)")
+    gc.collect()
     # max-throughput BERT rung ladder (TPU only — the CPU stand-in says
     # nothing about the remat trade)
     if on_tpu and budget_left() > 120:
@@ -1603,6 +1676,19 @@ def _plan_main():
                       "plan": bench_plan(on_tpu)}))
 
 
+def _goodput_main():
+    """``python bench.py --goodput``: ONLY the goodput ledger leg on
+    the ambient backend, one JSON line — cheap enough for a short
+    tunnel window, and the embedded ledger feeds the
+    ``goodput_violations`` audit and ``tools/bench_trend.py``."""
+    from apex_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+    on_tpu = jax.default_backend() == "tpu"
+    print(json.dumps({"metric": "goodput_ledger",
+                      "backend": jax.default_backend(),
+                      "goodput": bench_goodput(on_tpu)}))
+
+
 def _spmd_main():
     """``python bench.py --spmd``: ONLY the SPMD step-engine family A/B
     on the ambient backend, one JSON line — the leg tpu_watch.sh runs
@@ -1625,6 +1711,8 @@ if __name__ == "__main__":
         _plan_main()
     elif "--spmd" in sys.argv:
         _spmd_main()
+    elif "--goodput" in sys.argv:
+        _goodput_main()
     elif "--inner" in sys.argv:
         _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:

@@ -18,6 +18,13 @@ inherently host-side boundary):
     step; sanctioned explicitly so future capture helpers that need a
     sync boundary (closing a profiler window flushes the device) have
     a documented home
+  * ``telemetry/goodput.py``   — the run-level goodput ledger:
+    sanctioned explicitly (ISSUE 15) even though it performs NO host
+    syncs today — every number it touches is a host ``perf_counter``
+    microsecond, and ``tests/L0/test_goodput.py`` asserts the disabled
+    ledger does zero syncs and zero per-record allocation growth; the
+    explicit row documents that any future sync added here must stay
+    inside the registry-flush batching window
   * ``resilience/guard.py``    — the batched health-check/snapshot read
   * ``checkpoint.py``          — serialization is a host operation
   * ``interop/__init__.py``    — the torch bridge is host-side by design
@@ -45,6 +52,7 @@ SANCTIONED = {
     os.path.join("telemetry", "events.py"),
     os.path.join("telemetry", "memory.py"),
     os.path.join("telemetry", "timeline.py"),
+    os.path.join("telemetry", "goodput.py"),
     os.path.join("resilience", "guard.py"),
     "checkpoint.py",
     os.path.join("interop", "__init__.py"),

@@ -644,3 +644,91 @@ def test_cli_renders_per_op_table_and_step_summary(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
     assert r2.returncode == 0, r2.stderr[-2000:]
     assert "step-metrics summary" in r2.stdout
+
+
+# ---------------------------------------------------------------------------
+# report degrade paths (ISSUE 15 satellite): empty / partial / torn-tail
+# JSONL streams — the paths existed but were untested
+# ---------------------------------------------------------------------------
+
+def test_summarize_empty_stream_renders(tmp_path):
+    """An empty (or all-blank) JSONL is a valid degenerate run: zero
+    records, a summary full of zeros/Nones, and a render that does not
+    crash on any missing field."""
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    recs = treport.load_records(str(p))
+    assert recs == []
+    s = treport.summarize(recs)
+    assert s["steps"] == 0 and s["step_time_ms"] is None
+    assert s["loss_scale"] is None and s["goodput_fraction"] is None
+    text = treport.format_summary(s)
+    assert "step-metrics summary" in text and "n/a" in text
+    # blank lines only: same degenerate path
+    p.write_text("\n\n   \n")
+    assert treport.load_records(str(p)) == []
+
+
+def test_summarize_partial_stream_events_only(tmp_path):
+    """A stream holding ONLY events (a run that died before its first
+    metric flush) still summarizes: the resilience line counts them and
+    every metric aggregate degrades to its empty default."""
+    p = tmp_path / "partial.jsonl"
+    recs = [{"kind": "event", "ts": "2026-01-01T00:00:00Z", "step": 3,
+             "name": "fault_injected", "fields": {"kind": "nan"}},
+            {"kind": "event", "ts": "2026-01-01T00:00:01Z", "step": 4,
+             "name": "rollback", "fields": {"to_step": 2}}]
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    s = treport.summarize(treport.load_records(str(p)))
+    assert s["faults_injected"] == 1 and s["rollbacks"] == 1
+    assert s["steps"] == 4 and s["step_time_ms"] is None
+    assert s["collective_bytes"] == 0.0
+    text = treport.format_summary(s)
+    assert "resilience" in text and "rollbacks 1" in text
+
+
+def test_load_records_torn_tail_and_off_schema(tmp_path):
+    """A writer killed mid-append loses ONLY its torn last line (and
+    any off-schema record is skipped, not fatal) — unless the caller
+    opts into validate=True, which names the bad line."""
+    p = tmp_path / "torn.jsonl"
+    good = {"kind": "metric", "ts": "2026-01-01T00:00:00Z", "step": 1,
+            "name": "step_time_ms", "type": "histogram",
+            "stats": {"count": 1, "sum": 2.0, "min": 2.0, "max": 2.0,
+                      "mean": 2.0}, "cum_count": 1}
+    off_schema = {"kind": "metric", "ts": "x"}   # missing required keys
+    p.write_text(json.dumps(good) + "\n"
+                 + json.dumps(off_schema) + "\n"
+                 + '{"kind": "metric", "ts": "2026-01-01T00')   # torn
+    recs = treport.load_records(str(p))
+    assert len(recs) == 1 and recs[0]["name"] == "step_time_ms"
+    s = treport.summarize(recs)
+    assert s["step_time_ms"]["count"] == 1
+    treport.format_summary(s)
+    with pytest.raises(ValueError):
+        treport.load_records(str(p), validate=True)
+
+
+def test_summary_goodput_line_folds_next_to_resilience(tmp_path):
+    """The goodput line (ISSUE 15): exported ledger gauges in the
+    stream render as `goodput fraction ... badput: ...` alongside the
+    resilience/memory lines."""
+    ts = "2026-01-01T00:00:00Z"
+    recs = [
+        {"kind": "metric", "ts": ts, "step": 9, "name":
+         "goodput.fraction", "type": "gauge", "value": 0.82},
+        {"kind": "metric", "ts": ts, "step": 9, "name":
+         "badput.data_stall_ms", "type": "gauge", "value": 120.5},
+        {"kind": "metric", "ts": ts, "step": 9, "name":
+         "badput.recompile_ms", "type": "gauge", "value": 0.0},
+        {"kind": "event", "ts": ts, "step": 4, "name": "rollback",
+         "fields": {}},
+    ]
+    assert records_violations(recs) == []
+    s = treport.summarize(recs)
+    assert s["goodput_fraction"] == pytest.approx(0.82)
+    assert s["badput_ms"]["data_stall"] == pytest.approx(120.5)
+    text = treport.format_summary(s)
+    assert "goodput             fraction 0.820" in text
+    assert "data stall 120.5ms" in text
+    assert "recompile" not in text        # zero classes stay quiet

@@ -53,6 +53,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_ELASTIC_CMD": "",
            # and its real-data twin (stage 3b-real)
            "APEX_WATCH_ELASTIC_REAL_CMD": "",
+           # and the bench-trend/goodput watchdog (stage 4b)
+           "APEX_WATCH_TREND_CMD": "",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
            **env_extra}
@@ -714,6 +716,65 @@ def test_elastic_real_data_stage(tmp_path):
     assert "elastic real-data proof done rc=1" in log3
     assert not (tmp_path / "REAL_FAIL.json").exists()
     assert not (tmp_path / "REAL_FAIL.json.run").exists()
+
+
+def test_bench_trend_stage_artifact_and_span(tmp_path):
+    """ISSUE 15 satellite: the bench-trend/goodput regression watchdog
+    runs as watch stage 4b — artifact written atomically, watch.goodput
+    span appended to the streaming timeline, skip-when-complete, and
+    (unlike the A/B stages) the artifact is KEPT on rc=1: drift is the
+    finding, the trend doc is its evidence."""
+    fake = json.dumps({"kind": "bench_trend", "version": 1,
+                       "regressions": [], "ok": True})
+    marker = tmp_path / "trend_calls"
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    }
+    r, log = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_TREND_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    art = json.loads((tmp_path / "BENCH_TREND_r5.json").read_text())
+    assert art["kind"] == "bench_trend" and art["ok"] is True
+    assert "bench trend watchdog done rc=0" in log
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.goodput" in names
+    # second window: artifact present -> stage skipped
+    r2, _ = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_TREND_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+
+    # a DRIFTING watchdog (rc=1) still leaves its evidence artifact
+    drift = json.dumps({"kind": "bench_trend", "version": 1,
+                        "regressions": [{"series": "rn50:step_ms"}],
+                        "ok": False})
+    r3, log3 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_TREND_JSON": "TREND_DRIFT.json",
+        "APEX_WATCH_TREND_CMD": f"echo '{drift}'; false",
+    })
+    assert r3.returncode == 0
+    assert "bench trend watchdog done rc=1" in log3
+    art3 = json.loads((tmp_path / "TREND_DRIFT.json").read_text())
+    assert art3["ok"] is False and art3["regressions"]
+
+    # a wedge that printed NOTHING leaves no truncated artifact
+    r4, log4 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_TREND_JSON": "TREND_EMPTY.json",
+        "APEX_WATCH_TREND_CMD": "false",
+    })
+    assert r4.returncode == 0
+    assert not (tmp_path / "TREND_EMPTY.json").exists()
+    assert not (tmp_path / "TREND_EMPTY.json.run").exists()
 
 
 def test_stage_spans_record_failures_too(tmp_path):

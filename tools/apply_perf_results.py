@@ -122,6 +122,55 @@ def _telemetry_schema():
     return mod
 
 
+def _goodput_schema():
+    """The committed goodput-ledger schema
+    (apex_tpu/telemetry/goodput.py), loaded file-based like
+    :func:`_telemetry_schema` so the CLI never pays the jax import
+    (the goodput module keeps jax AND its package-relative imports out
+    of module scope for exactly this)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_apex_tpu_telemetry_goodput",
+        os.path.join(REPO, "apex_tpu", "telemetry", "goodput.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def goodput_violations(artifact) -> list:
+    """Audit for every goodput-ledger doc embedded in an artifact
+    (ISSUE 15): the ``goodput`` block the bench leg embeds and the
+    guard's ``GOODPUT.json`` both carry ``kind: "goodput_ledger"`` —
+    each must satisfy the committed ledger schema, whose load-bearing
+    checks are that the classes PARTITION the measured wall-clock
+    exactly, every fraction sits in [0, 1], and replay badput is
+    present iff a rollback/restore was metered.  Warnings only, same
+    posture as the other audits."""
+    out = []
+    schema = None   # loaded once, and only if a ledger doc exists
+
+    def walk(node, path):
+        nonlocal schema
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+            return
+        if not isinstance(node, dict):
+            return
+        if node.get("kind") == "goodput_ledger":
+            if schema is None:
+                schema = _goodput_schema()
+            out.extend(f"{path}: {v}"
+                       for v in schema.goodput_violations(node))
+            return   # a ledger doc has no nested ledgers
+        for k, v in node.items():
+            if k != "telemetry":
+                walk(v, f"{path}.{k}")
+
+    walk(artifact if isinstance(artifact, dict) else {}, "artifact")
+    return out
+
+
 def telemetry_violations(artifact) -> list:
     """Schema complaints for every ``telemetry`` block embedded in a
     bench artifact (``{"records": [...], "summary": {...}}`` blocks, as
@@ -178,11 +227,13 @@ def perf_field_violations(artifact) -> list:
         tel = node.get("telemetry")
         if isinstance(tel, dict) and node.get("_backend") in (None, "tpu") \
                 and node.get("leg") not in ("collectives",
-                                            "update_sharding"):
-            # the collectives / update_sharding A/B legs carry byte+ms
-            # evidence, not MFU — their own audits
-            # (collective_violations / update_sharding_violations)
-            # check them instead
+                                            "update_sharding",
+                                            "goodput"):
+            # the collectives / update_sharding / goodput legs carry
+            # byte+ms / wall-partition evidence, not MFU — their own
+            # audits (collective_violations /
+            # update_sharding_violations / goodput_violations) check
+            # them instead
             recs = tel.get("records") or []
             gauges = {r.get("name") for r in recs
                       if isinstance(r, dict) and r.get("type") == "gauge"}
@@ -843,6 +894,10 @@ def main(argv=None):
             # and any one-step profiled-capture overlap block (the
             # exposed-comm evidence must be internally consistent)
             for v in overlap_violations(art):
+                print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
+            # and every embedded goodput ledger (classes must partition
+            # the wall exactly; replay badput iff rollbacks metered)
+            for v in goodput_violations(art):
                 print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
 
     prof, rows = decide(bench, kern)

@@ -151,6 +151,15 @@ SPMD_TO=${APEX_WATCH_SPMD_TO:-400}
 TL_CMD=${APEX_WATCH_TIMELINE_CMD-"python -m apex_tpu.telemetry timeline $SPMD_PROFILE --json"}
 TL_JSON=${APEX_WATCH_TIMELINE_JSON:-TIMELINE_r5.json}
 TL_TO=${APEX_WATCH_TIMELINE_TO:-120}
+# stage 4b: bench-trend / goodput regression watchdog (ISSUE 15) —
+# ingest the committed BENCH_r*/BENCH_TPU_r* trajectory plus any
+# GOODPUT*.json run ledgers and flag per-leg step-time/MFU/goodput
+# drift beyond the tolerance band (TPU-backed drift fails; CPU noise
+# warns).  Runs AFTER apply so a fresh capture is already on disk.
+# ${VAR-default}: an explicitly EMPTY override disables the stage
+TREND_CMD=${APEX_WATCH_TREND_CMD-"python tools/bench_trend.py --json"}
+TREND_JSON=${APEX_WATCH_TREND_JSON:-BENCH_TREND_r5.json}
+TREND_TO=${APEX_WATCH_TREND_TO:-120}
 INTEROP_CMD=${APEX_WATCH_INTEROP_CMD:-"python tools/bench_interop.py"}
 INTEROP_JSON=${APEX_WATCH_INTEROP_JSON:-INTEROP_r5.json}
 INTEROP_TO=${APEX_WATCH_INTEROP_TO:-600}
@@ -438,6 +447,22 @@ for i in $(seq 1 "$N_PROBES"); do
     rc_apply=$?
     stage_span apply "$t0" "$rc_apply"
     echo "$(date +%H:%M:%S) apply_perf_results done rc=$rc_apply" >> "$LOG"
+    # ---- stage 4b: bench-trend/goodput regression watchdog ----
+    # skip-when-complete + atomic .run->mv; the artifact is KEPT even
+    # on rc=1 — drift is the finding, the trend doc is its evidence
+    if [ -n "$TREND_CMD" ] && [ ! -s "$TREND_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$TREND_TO" bash -c "$TREND_CMD" > "$TREND_JSON".run 2>> "$LOG"
+      rcbt=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span goodput "$t0" "$rcbt"
+      if [ -s "$TREND_JSON".run ]; then
+        mv "$TREND_JSON".run "$TREND_JSON"
+      else
+        # a wedged/failed watchdog never leaves a truncated artifact
+        rm -f "$TREND_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) bench trend watchdog done rc=$rcbt" >> "$LOG"
+    fi
     # ---- stage 5: interop bridge cost (best-effort; CPU-side meas.) ----
     if [ -n "$INTEROP_CMD" ] && [ ! -s "$INTEROP_JSON" ]; then
       t0=$(now_us)
