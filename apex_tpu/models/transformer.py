@@ -58,6 +58,17 @@ class TransformerConfig:
                                   # path per-config instead of mutating
                                   # APEX_TPU_XENT_IMPL (trace-time env
                                   # reads don't survive retraces)
+    scan_unroll: int = 1          # layer-scan unroll factor.  >1 clones
+                                  # the layer body so consecutive
+                                  # layers' grads become SEPARATE ops a
+                                  # bucketed dp reduction can interleave
+                                  # with (parallel.overlap) — the TPU
+                                  # overlap enabler.  Explicit opt-in:
+                                  # unrolling changes XLA fusion
+                                  # boundaries, so the fp32 bitwise
+                                  # parity contract only covers runs
+                                  # comparing like against like (same
+                                  # unroll both legs)
 
     @property
     def head_dim(self) -> int:
@@ -284,7 +295,12 @@ def transformer_apply(params, tokens, cfg: TransformerConfig, *,
 
     xs = (params["layers"], layer_rngs) if layer_rngs is not None \
         else params["layers"]
-    x, _ = jax.lax.scan(body, x, xs)
+    # unroll>1 (cfg.scan_unroll) threads the layer carry through cloned
+    # bodies, turning the one-op-for-all-layers scan grad into per-layer
+    # ops the bucketed dp reduction (parallel.overlap) can launch
+    # between — XLA cannot schedule a collective into the middle of a
+    # single scan op
+    x, _ = jax.lax.scan(body, x, xs, unroll=int(cfg.scan_unroll))
 
     hd = params["head"]
     x = fused_layer_norm_affine(x, hd["ln_g"].astype(dt), hd["ln_b"].astype(dt),

@@ -23,11 +23,28 @@ What survives as *semantics* (and is implemented here):
   - parameter broadcast at wrap time (``distributed.py:254``) — in SPMD,
     enforcing a replicated sharding on the param pytree.
 
-Knobs that are declared no-ops (kept for API compat, documented here against
-``distributed.py:162-175``): ``message_size``, ``delay_allreduce``,
-``allreduce_trigger_params``, ``num_allreduce_streams``,
-``retain_allreduce_buffers`` — bucket sizing, hook timing and stream fan-out
-have no SPMD meaning; XLA owns scheduling.
+Async overlap execution (``parallel.overlap``, docs/parallel.md): the
+reference's comm-ready-bucket machinery DOES translate one level down —
+``overlap="bucketed"`` (or ``APEX_TPU_OVERLAP`` / the measured
+``ddp_overlap`` tuning key) partitions the grad pytree into
+``message_size``-element buckets in reverse flat (≈ grad-production)
+order and issues one collective per bucket, each depending only on its
+own leaves, so XLA's latency-hiding scheduler overlaps them with the
+backward compute that produces the next bucket — the role of
+``bucket_streams``, recovered without hooks or streams.
+``message_size`` is therefore LIVE again (the reference's
+``distributed.py:162`` threshold, in elements), and
+``delay_allreduce=True`` is the explicit documented deferred path: it
+pins overlap off (one reduction after backward), exactly the
+reference's escape hatch for models whose backward graph varies.
+Schemes that cannot stream per-bucket (adasum's pairwise tree needs
+the full grad set; callable per-leaf routing has no bucket meaning)
+fall back to the deferred path with a one-time warning.
+
+Knobs that remain no-ops (kept for API compat, documented here against
+``distributed.py:162-175``): ``allreduce_trigger_params``,
+``num_allreduce_streams``, ``retain_allreduce_buffers`` — hook timing
+and stream fan-out have no SPMD meaning; XLA owns scheduling.
 
 Beyond the reference: per-bucket compressed/adaptive collective schemes
 (``parallel.collectives`` — bf16, block-scaled int8 with error-feedback
@@ -250,14 +267,13 @@ class DistributedDataParallel:
                  collective_min_bytes: Optional[int] = None,
                  update_sharding: Optional[str] = None,
                  allgather_scheme=None,
+                 overlap: Optional[str] = None,
                  prof: bool = False):
         if shared_param is not None:
             # same deprecation as distributed.py:178-181
             raise ValueError("shared_param is deprecated in the reference and "
                              "unsupported here")
         for name, val, default in (
-                ("message_size", message_size, 10_000_000),
-                ("delay_allreduce", delay_allreduce, False),
                 ("allreduce_trigger_params", allreduce_trigger_params, None),
                 ("retain_allreduce_buffers", retain_allreduce_buffers, False),
                 ("num_allreduce_streams", num_allreduce_streams, 1),
@@ -267,6 +283,26 @@ class DistributedDataParallel:
                     f"DistributedDataParallel({name}=...) is a no-op under "
                     "SPMD: XLA owns collective scheduling (see module "
                     "docstring vs distributed.py:162-175)")
+        # async overlap execution (parallel.overlap): "off" | "bucketed";
+        # None resolves APEX_TPU_OVERLAP then the tuning profile's
+        # ddp_overlap AT TRACE TIME (so a Plan.apply env pin flips it).
+        # delay_allreduce=True is the explicit deferred path and pins
+        # overlap off — the reference's own semantics (delayed
+        # allreduce ⇔ no comm-ready buckets, distributed.py:171-175).
+        # An invalid explicit value fails HERE, not at first step.
+        if overlap is not None:
+            from . import overlap as _ov
+            _ov.resolve_mode(overlap)
+            if overlap == "bucketed" and delay_allreduce:
+                from . import overlap as _ov2
+                _ov2.warn_once(
+                    ("delay_vs_overlap", axis_name),
+                    "DistributedDataParallel(delay_allreduce=True) pins the "
+                    "deferred path; the explicit overlap='bucketed' request "
+                    "is ignored")
+        self.overlap = overlap
+        self.message_size = int(message_size)
+        self.delay_allreduce = bool(delay_allreduce)
         self.module = module
         self.axis_name = axis_name
         self.gradient_average = gradient_average
@@ -311,9 +347,39 @@ class DistributedDataParallel:
     def allreduce_grads(self, grads, residuals=None):
         """Reduce a gradient pytree over the data axis (the sum of all of
         ``allreduce_bucket``/``allreduce_fallback``/``comm_ready_buckets``,
-        distributed.py:426-557, expressed as one psum).  ``residuals``
-        threads the int8 error-feedback state (see ``allreduce_tree``);
-        when passed, returns ``(grads, new_residuals)``."""
+        distributed.py:426-557).  ``residuals`` threads the int8
+        error-feedback state (see ``allreduce_tree``); when passed,
+        returns ``(grads, new_residuals)``.
+
+        Overlap dispatch happens HERE, at trace time: the resolved mode
+        (constructor ``overlap`` > ``APEX_TPU_OVERLAP`` > tuning
+        ``ddp_overlap``; ``delay_allreduce=True`` pins ``"off"``)
+        selects the backward-bucketed path
+        (:func:`~apex_tpu.parallel.overlap.bucketed_allreduce` — one
+        collective per ``message_size``-element bucket, schedulable
+        against remaining backward) or the deferred single-pass
+        ``allreduce_tree``.  Schemes that cannot stream per-bucket fall
+        back to deferred with a one-time warning."""
+        from . import overlap as _ov
+        mode = ("off" if self.delay_allreduce
+                else _ov.resolve_mode(self.overlap))
+        if mode == "bucketed" and not _ov.can_stream(self.collective_scheme):
+            _ov.warn_once(
+                ("no_stream", str(self.collective_scheme)),
+                "overlap='bucketed' requested with a collective scheme "
+                "that cannot stream per-bucket (adasum's pairwise tree "
+                "needs the full grad set; callable routing is per-leaf) — "
+                "falling back to the deferred allreduce")
+            mode = "off"
+        if mode == "bucketed":
+            return _ov.bucketed_allreduce(
+                grads, axis_name=self.axis_name,
+                average=self.gradient_average,
+                predivide_factor=self.gradient_predivide_factor,
+                always_fp32=self.allreduce_always_fp32,
+                scheme=self.collective_scheme, residuals=residuals,
+                min_compress_bytes=self.collective_min_bytes,
+                message_size=self.message_size)
         return allreduce_tree(
             grads, axis_name=self.axis_name,
             average=self.gradient_average,
@@ -346,6 +412,9 @@ class DistributedDataParallel:
         kwargs.setdefault("allgather_scheme", self.allgather_scheme)
         kwargs.setdefault("gradient_predivide_factor",
                           self.gradient_predivide_factor)
+        kwargs.setdefault("overlap",
+                          "off" if self.delay_allreduce else self.overlap)
+        kwargs.setdefault("message_size", self.message_size)
         return _wu.ShardedUpdate(optimizer, axis_name=self.axis_name,
                                  gradient_average=self.gradient_average,
                                  **kwargs)
@@ -370,7 +439,9 @@ class Reducer:
     def __init__(self, module_or_grads_fn=None, *, axis_name: str = DATA_AXIS,
                  gradient_average: bool = True, collective_scheme=None,
                  collective_min_bytes: Optional[int] = None,
-                 update_sharding: Optional[str] = None):
+                 update_sharding: Optional[str] = None,
+                 overlap: Optional[str] = None,
+                 message_size: int = 10_000_000):
         self.module = module_or_grads_fn
         self.axis_name = axis_name
         self.gradient_average = gradient_average
@@ -380,8 +451,32 @@ class Reducer:
             from . import weight_update as _wu
             _wu.resolve_mode(update_sharding)
         self.update_sharding = update_sharding
+        # async overlap execution, same contract as DDP (no
+        # delay_allreduce here — the Reducer is already manual-trigger)
+        if overlap is not None:
+            from . import overlap as _ov
+            _ov.resolve_mode(overlap)
+        self.overlap = overlap
+        self.message_size = int(message_size)
 
     def reduce(self, grads, residuals=None):
+        from . import overlap as _ov
+        mode = _ov.resolve_mode(self.overlap)
+        if mode == "bucketed" and not _ov.can_stream(self.collective_scheme):
+            _ov.warn_once(
+                ("no_stream", str(self.collective_scheme)),
+                "overlap='bucketed' requested with a collective scheme "
+                "that cannot stream per-bucket (adasum's pairwise tree "
+                "needs the full grad set; callable routing is per-leaf) — "
+                "falling back to the deferred allreduce")
+            mode = "off"
+        if mode == "bucketed":
+            return _ov.bucketed_allreduce(
+                grads, axis_name=self.axis_name,
+                average=self.gradient_average,
+                scheme=self.collective_scheme, residuals=residuals,
+                min_compress_bytes=self.collective_min_bytes,
+                message_size=self.message_size)
         return allreduce_tree(grads, axis_name=self.axis_name,
                               average=self.gradient_average,
                               scheme=self.collective_scheme,
@@ -397,6 +492,8 @@ class Reducer:
             return None
         kwargs.setdefault("collective_scheme", self.collective_scheme)
         kwargs.setdefault("collective_min_bytes", self.collective_min_bytes)
+        kwargs.setdefault("overlap", self.overlap)
+        kwargs.setdefault("message_size", self.message_size)
         return _wu.ShardedUpdate(optimizer, axis_name=self.axis_name,
                                  gradient_average=self.gradient_average,
                                  **kwargs)

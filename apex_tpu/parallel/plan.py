@@ -116,20 +116,37 @@ SP_MIN_SEQ = 2048
 ENV_OVERLAP = "APEX_TPU_OVERLAP_FRACTION"
 
 
-def resolve_overlap_fraction(explicit: Optional[float] = None) -> float:
+def resolve_overlap_fraction(explicit: Optional[float] = None, *,
+                             scheme: Optional[str] = None) -> float:
     """The dp-comm overlap factor: the fraction of modeled collective
     time the step actually EXPOSES (``telemetry.timeline``'s measured
     ``exposed_comm_fraction``, persisted by ``apply_perf_results`` as
     the ``overlap_measured_fraction`` tuning key).  Clamped to [0, 1];
     without any measurement the model keeps charging the full wire
-    time — exactly the synchronous engine it describes."""
+    time — exactly the synchronous engine it describes.
+
+    ``scheme`` names the plan's collective scheme: overlap-capable
+    plans (the dp family, where bucketed execution applies) consult the
+    per-scheme measurement ``overlap_fraction_<scheme>`` first — how
+    much wire time bucketed execution exposes depends on the wire
+    (int8's ~4x fewer bytes hide far more easily than fp32's), so one
+    global fraction would mis-price the codec trade the planner exists
+    to settle (EQuARX, arXiv:2506.17615).  Precedence: explicit arg >
+    ``APEX_TPU_OVERLAP_FRACTION`` env > ``overlap_fraction_<scheme>``
+    (when ``scheme`` given) > global ``overlap_measured_fraction`` >
+    1.0."""
     if explicit is None:
         env = os.environ.get(ENV_OVERLAP)
         if env:
             explicit = float(env)
         else:
             from ..utils import tuning
-            v = tuning.get("overlap_measured_fraction")
+            v = None
+            if scheme:
+                v = tuning.get(f"overlap_fraction_{scheme}")
+            if not (isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                v = tuning.get("overlap_measured_fraction")
             explicit = v if isinstance(v, (int, float)) \
                 and not isinstance(v, bool) else 1.0
     return min(max(float(explicit), 0.0), 1.0)
@@ -580,7 +597,13 @@ def predict(profile: ModelProfile, plan: Plan, ceilings=None,
     modeled comm stays visible in ``breakdown["dp_comm_ms"]``;
     ``breakdown["dp_comm_exposed_ms"]`` is what the total charges."""
     ceil = _resolve_ceil(ceilings, platform or profile.platform)
-    overlap = resolve_overlap_fraction(overlap_fraction)
+    # overlap-capable plans (the dp family — the wire bucketed
+    # execution streams) consume the per-scheme measured fraction;
+    # other families keep the single global measurement (their dp wire,
+    # if any, is not bucket-scheduled by this engine)
+    overlap = resolve_overlap_fraction(
+        overlap_fraction,
+        scheme=(plan.collective_scheme if plan.family == "dp" else None))
     dp, tp, sp = plan.dp, plan.tp, plan.sp
     shards = dp * tp * sp
 
@@ -832,9 +855,18 @@ def build_flagship_step(cfg, mesh, *, global_batch: int,
             params, state = su.step(state, grads, params)
             return params, state, jax.lax.pmean(loss, DATA_AXIS)
 
+    # async overlap enabler (parallel.overlap): donate the carry so XLA
+    # can retire each bucket's pre-reduction buffer in place and
+    # schedule the per-bucket collectives against remaining backward
+    # compute without doubling live HBM.  TPU only — the CPU backend
+    # ignores donation (with a warning per buffer), and the CPU-mesh
+    # A/B tests reuse the un-donated carry across calls.
+    jit_kw = {}
+    if jax.default_backend() == "tpu":
+        jit_kw["donate_argnums"] = (0, 1)
     step_sm = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(pspec, sspec, P(DATA_AXIS)),
-        out_specs=(pspec, sspec, P()), **vma_kw))
+        out_specs=(pspec, sspec, P()), **vma_kw), **jit_kw)
     state0 = opt.init(params0) if su is None else init_s(params0)
 
     def step(carry, tokens):

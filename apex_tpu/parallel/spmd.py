@@ -192,8 +192,18 @@ def build_plan_step(cfg, mesh, plan, *, global_batch: int, lr: float = 1e-2,
     if plan.sp > 1:
         return _build_sp_step(cfg, mesh, plan, global_batch, lr, meter)
     from .plan import build_flagship_step
-    carry0, step = build_flagship_step(cfg, mesh, global_batch=global_batch)
-    return carry0, step, {"family": family, "engine": "shard_map.dp"}
+    # async overlap execution rides the dp engine: resolve the ambient
+    # mode here (env APEX_TPU_OVERLAP / tuning ddp_overlap — what
+    # Plan.apply or the watcher A/B sets) and surface it both to the
+    # DDP harness and in the engine info, so the A/B artifact records
+    # which execution actually ran
+    from . import overlap as _ov
+    ov_mode = _ov.resolve_mode(None)
+    ddp_kwargs = {"overlap": ov_mode} if ov_mode != "off" else None
+    carry0, step = build_flagship_step(cfg, mesh, global_batch=global_batch,
+                                       ddp_kwargs=ddp_kwargs)
+    return carry0, step, {"family": family, "engine": "shard_map.dp",
+                          "overlap": ov_mode}
 
 
 def _build_gspmd_step(cfg, mesh, plan, global_batch, lr, amp_dtype, meter):

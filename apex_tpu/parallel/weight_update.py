@@ -194,7 +194,9 @@ class ShardedUpdate:
                  check_overflow: bool = True,
                  collective_scheme=None,
                  collective_min_bytes: Optional[int] = None,
-                 allgather_scheme=None):
+                 allgather_scheme=None,
+                 overlap: Optional[str] = None,
+                 message_size: Optional[int] = None):
         if getattr(optimizer, "impl", None) != "fused":
             raise ValueError(
                 "weight-update sharding needs the flat engine: construct "
@@ -209,6 +211,19 @@ class ShardedUpdate:
         self.collective_scheme = collective_scheme
         self.collective_min_bytes = collective_min_bytes
         self.allgather_scheme = allgather_scheme
+        # async overlap execution (parallel.overlap): "bucketed" issues
+        # the grad reduce-scatter per column-chunk and the param
+        # allgather per shard segment (~``message_size`` elements each),
+        # so XLA can overlap each chunk's wire time with the backward
+        # compute behind the next one / the forward compute consuming
+        # the previous one.  Resolution is TRACE-TIME (explicit arg >
+        # APEX_TPU_OVERLAP > tuning ddp_overlap); fp32 chunking is
+        # bitwise vs the whole-buffer path, block-aligned int8 too.
+        if overlap is not None:
+            from . import overlap as _ov
+            _ov.resolve_mode(overlap)
+        self.overlap = overlap
+        self.message_size = message_size
 
     # -- packing -------------------------------------------------------------
 
@@ -340,6 +355,10 @@ class ShardedUpdate:
         structure/dtypes (the fused master contract); ``scale`` divides
         grads (amp loss-scale interop)."""
         from . import collectives as _coll
+        from . import overlap as _ov
+        mode = _ov.resolve_mode(self.overlap)
+        msize = (self.message_size if self.message_size is not None
+                 else _ov.DEFAULT_MESSAGE_SIZE)
         n = lax_axis_size(self.axis_name)
         fl = self._fl(params, n)
         flat_g = fl.flatten(grads)
@@ -397,10 +416,39 @@ class ShardedUpdate:
             info = _coll.get_scheme(spec.scheme) if spec is not None else None
             if pre != 1.0:
                 flat_g = flat_g * pre
-            t0 = time.perf_counter()
-            g_shard, new_residual = _coll.reduce_scatter_flat(
-                flat_g, self.axis_name, spec, residual=residual,
-                label="ddp.reduce_scatter")
+            # async overlap: issue the scatter per column-chunk so each
+            # chunk's collective depends only on its own grad bytes —
+            # XLA overlaps chunk k's wire with the compute behind chunk
+            # k+1.  Adasum's merge couples the whole buffer and cannot
+            # stream (one-time warning, deferred fallback).
+            stream = mode == "bucketed"
+            if stream and info is not None and info.self_scaling:
+                _ov.warn_once(
+                    ("no_stream_rs", spec.scheme),
+                    "overlap='bucketed' requested with a collective scheme "
+                    "that cannot stream per-chunk (adasum's pairwise merge "
+                    "needs the full grad buffer) — falling back to the "
+                    "whole-buffer reduce-scatter")
+                stream = False
+            _sname = spec.scheme if spec is not None else None
+            _sdtype = info.wire_dtype if info is not None else "float32"
+            if stream:
+                g_shard, new_residual, _ = _ov.chunked_reduce_scatter(
+                    flat_g, self.axis_name, spec, residual=residual,
+                    message_size=msize, label="ddp.reduce_scatter",
+                    on_chunk=lambda logical, wire, dt: self._meter(
+                        "reduce_scatter", logical, wire, dt,
+                        _sname, _sdtype))
+            else:
+                t0 = time.perf_counter()
+                g_shard, new_residual = _coll.reduce_scatter_flat(
+                    flat_g, self.axis_name, spec, residual=residual,
+                    label="ddp.reduce_scatter")
+                logical = flat_g.size * 4
+                self._meter("reduce_scatter", logical,
+                            (info.wire_bytes(flat_g.size, spec.block)
+                             if info is not None else logical),
+                            time.perf_counter() - t0, _sname, _sdtype)
             # adasum sets its own magnitude (only the predivide
             # pre-scale is undone; ``gradient_average`` is a no-op) —
             # everything else applies ``post``, matching allreduce_tree
@@ -411,13 +459,6 @@ class ShardedUpdate:
                 p_scale = post
             if p_scale != 1.0:
                 g_shard = g_shard * p_scale
-            logical = flat_g.size * 4
-            self._meter("reduce_scatter", logical,
-                        (info.wire_bytes(flat_g.size, spec.block)
-                         if info is not None else logical),
-                        time.perf_counter() - t0,
-                        spec.scheme if spec is not None else None,
-                        info.wire_dtype if info is not None else "float32")
 
         # -- the 1/N-slice update over the flat master/moment buffers
         ctx = ShardContext(self.axis_name, fl, n)
@@ -430,16 +471,31 @@ class ShardedUpdate:
             new_residual = jnp.where(ok > 0, new_residual, residual)
         self._gauge_state(new_state, n)
 
-        # -- allgather of the updated params (ddp.param_allgather)
+        # -- allgather of the updated params (ddp.param_allgather).
+        # Bucketed overlap issues it per shard segment — the segment
+        # gathers are mutually independent, so XLA overlaps segment
+        # k+1's wire with the unflatten/forward compute consuming
+        # segment k (the layer-by-layer prefetch, riding the same
+        # message_size schedule as the grad buckets in reverse).
         ag_spec = self._resolve_ag()
-        t0 = time.perf_counter()
-        full, ag_wire, ag_dtype = _coll.allgather_flat(
-            new_state.master, self.axis_name, ag_spec,
-            label="ddp.param_allgather")
-        self._meter("param_allgather", new_state.master.size * 4, ag_wire,
-                    time.perf_counter() - t0,
-                    ag_spec.scheme if ag_spec is not None else None,
-                    ag_dtype)
+        _agname = ag_spec.scheme if ag_spec is not None else None
+        _agdtype = {"int8_blockscale": "int8",
+                    "bf16": "bfloat16"}.get(_agname, "float32")
+        if mode == "bucketed":
+            full, ag_wire, ag_dtype, _ = _ov.segmented_allgather(
+                new_state.master, self.axis_name, ag_spec,
+                message_size=msize, label="ddp.param_allgather",
+                on_segment=lambda logical, wire, dt: self._meter(
+                    "param_allgather", logical, wire, dt, _agname,
+                    _agdtype))
+        else:
+            t0 = time.perf_counter()
+            full, ag_wire, ag_dtype = _coll.allgather_flat(
+                new_state.master, self.axis_name, ag_spec,
+                label="ddp.param_allgather")
+            self._meter("param_allgather", new_state.master.size * 4,
+                        ag_wire, time.perf_counter() - t0, _agname,
+                        ag_dtype)
 
         new_params = fl.unflatten(full, like=params)
         if residual is None:

@@ -33,6 +33,11 @@ winners, and every tunable default consults it at trace time:
     (``overlap_measured_fraction``) via ``parallel.plan.predict`` —
     the exposed-comm fraction ``telemetry.timeline`` measured from the
     bench one-step profiled capture
+  - async overlap execution (``ddp_overlap`` via
+    ``parallel.overlap.resolve_mode``, plus the per-scheme
+    ``overlap_fraction_<scheme>`` fractions ``parallel.plan.predict``
+    prices overlap-capable dp plans with) — the measured winner of the
+    bench ``overlap`` A/B leg
 
 Precedence everywhere: explicit argument > env override > tuning
 profile > built-in default.  With no profile on disk nothing changes —
@@ -62,6 +67,8 @@ from typing import Any, Optional
 # ``_provenance`` (dict: ts/bench/kernels) rides alongside, exempt.
 _is_block = lambda v: isinstance(v, int) and not isinstance(v, bool) and v > 0
 _is_bool = lambda v: isinstance(v, bool)
+_is_frac = lambda v: (isinstance(v, (int, float)) and not isinstance(v, bool)
+                      and 0.0 <= v <= 1.0)
 SCHEMA = {
     "flash_block_q": _is_block,
     "flash_block_k": _is_block,
@@ -114,10 +121,18 @@ SCHEMA = {
     # capture (telemetry.timeline over the spmd leg's device trace) —
     # the overlap factor parallel.plan's comm model consumes: exposed
     # dp comm = modeled comm x fraction.  1.0 = fully synchronous
-    # (today's engine); the async-collective rewrite will lower it
-    "overlap_measured_fraction": lambda v: (isinstance(v, (int, float))
-                                            and not isinstance(v, bool)
-                                            and 0.0 <= v <= 1.0),
+    "overlap_measured_fraction": _is_frac,
+    # async overlap execution (parallel.overlap): the measured winner
+    # of the bench ``overlap`` A/B leg (consumed by
+    # overlap.resolve_mode when no explicit arg / APEX_TPU_OVERLAP env
+    # is given), plus the per-scheme exposed-comm fractions the A/B
+    # measured — overlap-capable dp plans price their wire with
+    # ``overlap_fraction_<scheme>`` instead of the global fraction
+    # (how much wire hides depends on how many bytes are on it)
+    "ddp_overlap": lambda v: v in ("off", "bucketed"),
+    "overlap_fraction_fp32": _is_frac,
+    "overlap_fraction_bf16": _is_frac,
+    "overlap_fraction_int8_blockscale": _is_frac,
 }
 
 

@@ -1218,6 +1218,118 @@ def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
     return out
 
 
+def bench_overlap(on_tpu, steps=6, cfg=None, global_batch=None):
+    """Async overlap execution A/B (PR 16, watcher stage 2g): the
+    flagship dp step with ``overlap="off"`` (the deferred reference
+    ``delay_allreduce`` semantics — every gradient allreduce after the
+    full backward) vs ``overlap="bucketed"`` (reverse-layer-order
+    size-thresholded buckets launched as backward produces them, so XLA
+    can hide the wire behind remaining compute).  Evidence per leg:
+    step ms, final loss (the legs must agree — bitwise for the fp32
+    scheme), the metered LOGICAL allreduce bytes (bucketing re-chunks
+    the wire, it must never change what is logically reduced), and —
+    under ``APEX_BENCH_PROFILE_DIR`` — a one-step profiled capture per
+    leg whose ``exposed_comm_fraction`` is the success criterion:
+    parity proves correctness, the bucketed fraction dropping below the
+    deferred one in the SAME artifact proves the overlap is real."""
+    import numpy as np
+    from apex_tpu import telemetry
+    from apex_tpu.parallel import collectives as coll
+    from apex_tpu.parallel import plan as planmod
+    from apex_tpu.telemetry import events as tel_events
+    from apex_tpu.telemetry import report as treport
+    from apex_tpu.telemetry import timeline as tlmod
+
+    n_dev = len(jax.devices())
+    if cfg is None:
+        cfg = planmod._flagship_cfg(on_tpu)
+    gb = global_batch or (32 if on_tpu else 8)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (gb, cfg.max_len)).astype("int32"))
+    spec = coll.resolve(None, min_bytes=None, block=None)
+    scheme = spec.scheme if spec is not None else "fp32"
+
+    sink = telemetry.MemorySink()
+    reg = telemetry.Registry(sink=sink, flush_interval=0,
+                             rank0_only=False, run_id="bench",
+                             memory=False)
+    h = reg.histogram("step_time_ms")
+    out = {"leg": "overlap", "chips": n_dev, "global_batch": gb,
+           "scheme": scheme, "modes": {}}
+    profile_dir = os.environ.get("APEX_BENCH_PROFILE_DIR")
+    prev = tel_events.set_default(reg)
+    try:
+        bytes_before = 0.0
+        for mode in ("off", "bucketed"):
+            _log(f"overlap leg: {mode} ...")
+            with planmod.Plan(dp=n_dev).apply() as mesh:
+                carry, step = planmod.build_flagship_step(
+                    cfg, mesh, global_batch=gb,
+                    ddp_kwargs={"overlap": mode})
+                t0 = time.perf_counter()
+                carry, loss = step(carry, tokens)
+                _sync(loss)
+                compile_ms = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    carry, loss = step(carry, tokens)
+                _sync(loss)
+                ms = (time.perf_counter() - t0) / steps * 1e3
+                rec = {"step_ms": round(ms, 3),
+                       "compile_ms": round(compile_ms, 1),
+                       "loss": float(loss)}
+                # metered LOGICAL bytes for THIS leg's trace (counters
+                # are cumulative across the shared registry: diff them)
+                reg.flush()
+                total = reg.counter("ddp.allreduce_bytes").total
+                rec["allreduce_logical_bytes"] = total - bytes_before
+                bytes_before = total
+                if profile_dir:
+                    # per-leg one-step profiled capture: the SAME
+                    # artifact must carry both fractions so the drop is
+                    # measured against the leg that proves parity
+                    leg_dir = os.path.join(profile_dir, mode)
+                    _log(f"overlap leg: one-step profiled capture -> "
+                         f"{leg_dir}")
+
+                    def _one_step(_carry=carry, _step=step):
+                        _, l = _step(_carry, tokens)
+                        _sync(l)
+
+                    rec["overlap"], decomp = _profiled_overlap_capture(
+                        _one_step, leg_dir)
+                    if decomp is not None:
+                        # step.exposed_comm_fraction + step.*_ms gauges
+                        # flushed per leg: two schema-valid records in
+                        # stream order, off first then bucketed
+                        tlmod.observe(decomp, reg)
+                        reg.flush()
+            h.observe(ms)
+            reg.gauge(f"overlap.{mode}.step_ms").set(ms)
+            out["modes"][mode] = rec
+            del carry, step
+            gc.collect()
+    finally:
+        tel_events.set_default(prev)
+    off, buck = out["modes"].get("off"), out["modes"].get("bucketed")
+    if off and buck:
+        out["loss_abs_diff"] = abs(buck["loss"] - off["loss"])
+        out["loss_bitwise_equal"] = buck["loss"] == off["loss"]
+        # fp32 keeps the reduction elementwise-identical (bitwise);
+        # quantized schemes requantize per bucket (fp32 tolerance)
+        tol = 0.0 if scheme == "fp32" else 5e-2 * max(1.0,
+                                                      abs(off["loss"]))
+        out["parity_ok"] = out["loss_abs_diff"] <= tol
+        out["logical_bytes_equal"] = (
+            buck["allreduce_logical_bytes"]
+            == off["allreduce_logical_bytes"])
+    reg.flush()
+    out["telemetry"] = {"records": sink.records,
+                        "summary": treport.summarize(sink.records)}
+    return out
+
+
 def bench_goodput(on_tpu, steps=10):
     """Run-level goodput ledger leg (ISSUE 15): a short, CLEAN
     ``TrainGuard``-driven flagship-transformer run — checkpoint anchor
@@ -1472,6 +1584,19 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
     else:
         _log("skipping spmd leg (budget)")
     gc.collect()
+    # async-overlap A/B (PR 16): deferred vs bucketed flagship step —
+    # loss parity + per-leg exposed-comm capture feeding the
+    # ddp_overlap / overlap_fraction_<scheme> decisions
+    if budget_left() > 60:
+        try:
+            with _leg_span("overlap"):
+                detail["overlap"] = bench_overlap(on_tpu)
+        except Exception as err:
+            detail["overlap"] = {"error": repr(err)[:200]}
+        flush("overlap", detail["overlap"])
+    else:
+        _log("skipping overlap leg (budget)")
+    gc.collect()
     # run-level goodput ledger leg (ISSUE 15): a short guard-driven run
     # whose GOODPUT ledger lands in the artifact for the
     # goodput_violations audit and the bench_trend.py watchdog
@@ -1689,6 +1814,19 @@ def _goodput_main():
                       "goodput": bench_goodput(on_tpu)}))
 
 
+def _overlap_main():
+    """``python bench.py --overlap``: ONLY the async-overlap execution
+    A/B on the ambient backend, one JSON line — the leg tpu_watch.sh
+    runs as its own stage 2g (an off-vs-bucketed A/B fits a short
+    tunnel window the full bench would waste)."""
+    from apex_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+    on_tpu = jax.default_backend() == "tpu"
+    print(json.dumps({"metric": "overlap_ab",
+                      "backend": jax.default_backend(),
+                      "overlap": bench_overlap(on_tpu)}))
+
+
 def _spmd_main():
     """``python bench.py --spmd``: ONLY the SPMD step-engine family A/B
     on the ambient backend, one JSON line — the leg tpu_watch.sh runs
@@ -1713,6 +1851,8 @@ if __name__ == "__main__":
         _spmd_main()
     elif "--goodput" in sys.argv:
         _goodput_main()
+    elif "--overlap" in sys.argv:
+        _overlap_main()
     elif "--inner" in sys.argv:
         _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:

@@ -389,6 +389,13 @@ def _stub_timings(bench, monkeypatch, wedge_at=None):
                         mk("bench_goodput",
                            {"leg": "goodput", "steps": 10,
                             "goodput_fraction": 0.9}))
+    monkeypatch.setattr(bench, "bench_overlap",
+                        mk("bench_overlap",
+                           {"leg": "overlap", "scheme": "fp32",
+                            "parity_ok": True,
+                            "logical_bytes_equal": True,
+                            "modes": {"off": {"step_ms": 2.0},
+                                      "bucketed": {"step_ms": 1.8}}}))
     monkeypatch.setattr(bench, "bench_plan",
                         mk("bench_plan",
                            {"leg": "plan", "chips": 8,
@@ -436,9 +443,11 @@ def test_run_bench_full_flush_sequence(tmp_path, monkeypatch):
     rn50_key = ("rn50" if jax.default_backend() == "tpu"
                 else "rn50_cpu_standin_resnet18")
     assert set(legs) == {"headline", rn50_key, "bert_e2e", "collectives",
-                         "update_sharding", "plan", "spmd", "goodput"}
+                         "update_sharding", "plan", "spmd", "overlap",
+                         "goodput"}
     assert legs["collectives"]["data"]["leg"] == "collectives"
     assert legs["goodput"]["data"]["leg"] == "goodput"
+    assert legs["overlap"]["data"]["leg"] == "overlap"
     assert legs["update_sharding"]["data"]["leg"] == "update_sharding"
     assert legs["plan"]["data"]["leg"] == "plan"
     assert legs["spmd"]["data"]["leg"] == "spmd"

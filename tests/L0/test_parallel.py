@@ -75,8 +75,17 @@ def test_ddp_noop_outside_mesh():
 
 
 def test_ddp_noop_knobs_warn():
+    # multi-stream options remain documented no-ops (XLA owns stream
+    # scheduling) ...
     with pytest.warns(UserWarning):
-        DistributedDataParallel(axis_name="data", message_size=1)
+        DistributedDataParallel(axis_name="data", num_allreduce_streams=2)
+    # ... but message_size is LIVE again since the async-overlap work
+    # (parallel.overlap bucket threshold) — it must NOT warn
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        ddp = DistributedDataParallel(axis_name="data", message_size=1)
+    assert ddp.message_size == 1
 
 
 def test_reducer_sum_vs_known(mesh):

@@ -66,6 +66,15 @@ and TPU-backed; absent keys leave the built-in defaults untouched):
                            comm model as its overlap factor; only
                            persisted when the capture actually
                            measured collective time (comm_ms > 0)
+  ddp_overlap           <- the bench ``overlap`` A/B leg (async
+                           overlap execution, parallel.overlap):
+                           "bucketed" iff the leg proved loss parity
+                           AND the bucketed step is no slower than the
+                           deferred baseline; the winner's per-leg
+                           profiled capture also pins
+                           overlap_fraction_<scheme> — the per-scheme
+                           exposed-comm fraction overlap-capable dp
+                           plans price their wire with
   plan_*                <- the bench ``plan`` A/B leg (auto-parallel
                            planner, parallel.plan): the MEASURED
                            winner's full knob dict (dp/tp/sp + zero /
@@ -228,12 +237,13 @@ def perf_field_violations(artifact) -> list:
         if isinstance(tel, dict) and node.get("_backend") in (None, "tpu") \
                 and node.get("leg") not in ("collectives",
                                             "update_sharding",
-                                            "goodput"):
-            # the collectives / update_sharding / goodput legs carry
-            # byte+ms / wall-partition evidence, not MFU — their own
-            # audits (collective_violations /
-            # update_sharding_violations / goodput_violations) check
-            # them instead
+                                            "goodput",
+                                            "overlap"):
+            # the collectives / update_sharding / goodput / overlap
+            # legs carry byte+ms / wall-partition / parity evidence,
+            # not MFU — their own audits (collective_violations /
+            # update_sharding_violations / goodput_violations /
+            # overlap_exec_violations) check them instead
             recs = tel.get("records") or []
             gauges = {r.get("name") for r in recs
                       if isinstance(r, dict) and r.get("type") == "gauge"}
@@ -406,6 +416,72 @@ def overlap_violations(artifact) -> list:
                 elif frac is not None:
                     out.append(f"{path}.overlap: fraction {frac!r} "
                                "claimed with no measured comm")
+        for k, v in node.items():
+            if k != "telemetry":
+                walk(v, f"{path}.{k}")
+
+    walk(artifact if isinstance(artifact, dict) else {}, "artifact")
+    return out
+
+
+def overlap_exec_violations(artifact) -> list:
+    """Audit for the bench ``overlap`` A/B leg (PR 16): the leg must
+    carry both modes (deferred ``off`` + ``bucketed``) with numeric
+    step times, the parity evidence must HOLD (bucketing re-chunks the
+    wire; it must never change the numbers — bitwise for the fp32
+    scheme), the metered LOGICAL allreduce bytes must match across
+    modes, and when both legs embed a profiled capture with measured
+    collective time, the bucketed ``exposed_comm_fraction`` must not
+    exceed the deferred one — an overlap execution that exposes MORE
+    wire than the deferred path is a regression, not a winner.
+    Warnings only, same posture as the other audits."""
+    out = []
+
+    def walk(node, path):
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+            return
+        if not isinstance(node, dict):
+            return
+        if node.get("leg") == "overlap" and isinstance(
+                node.get("modes"), dict):
+            modes = node["modes"]
+            rows = {m: r for m, r in modes.items()
+                    if isinstance(r, dict)
+                    and isinstance(r.get("step_ms"), (int, float))}
+            for need in ("off", "bucketed"):
+                if need not in rows:
+                    out.append(f"{path}: overlap leg carries no "
+                               f"measured {need!r} mode")
+            if "off" in rows and "bucketed" in rows:
+                if node.get("parity_ok") is not True:
+                    out.append(
+                        f"{path}: overlap leg parity not held "
+                        f"(parity_ok={node.get('parity_ok')!r}, "
+                        f"loss_abs_diff={node.get('loss_abs_diff')!r})")
+                if node.get("logical_bytes_equal") is not True:
+                    out.append(
+                        f"{path}: overlap leg metered LOGICAL bytes "
+                        "differ between modes (bucketing changed what "
+                        "is reduced)")
+                fracs = {}
+                for m, r in rows.items():
+                    ov = r.get("overlap")
+                    if isinstance(ov, dict) and "error" not in ov \
+                            and isinstance(ov.get("comm_ms"),
+                                           (int, float)) \
+                            and ov["comm_ms"] > 0 \
+                            and isinstance(
+                                ov.get("exposed_comm_fraction"),
+                                (int, float)):
+                        fracs[m] = ov["exposed_comm_fraction"]
+                if "off" in fracs and "bucketed" in fracs \
+                        and fracs["bucketed"] > fracs["off"] + 1e-6:
+                    out.append(
+                        f"{path}: bucketed exposed_comm_fraction "
+                        f"{fracs['bucketed']} exceeds deferred "
+                        f"{fracs['off']}")
         for k, v in node.items():
             if k != "telemetry":
                 walk(v, f"{path}.{k}")
@@ -792,6 +868,55 @@ def decide(bench, kern):
                     f"{ov.get('comm_ms')} ms collective time over "
                     f"{ov.get('devices')} devices"))
 
+        ov_leg = det.get("overlap")
+        if isinstance(ov_leg, dict) \
+                and ov_leg.get("_backend") in (None, "tpu") \
+                and isinstance(ov_leg.get("modes"), dict) \
+                and not overlap_exec_violations({"overlap": ov_leg}):
+            # ddp_overlap <- "bucketed" iff the A/B proved parity AND
+            # the bucketed step is no slower than deferred.  The audit
+            # above already enforced parity + logical-byte equality +
+            # fraction ordering; here only the election remains.
+            modes = ov_leg["modes"]
+            off_r = modes.get("off") or {}
+            buck_r = modes.get("bucketed") or {}
+            off_ms = off_r.get("step_ms")
+            buck_ms = buck_r.get("step_ms")
+            if isinstance(off_ms, (int, float)) \
+                    and isinstance(buck_ms, (int, float)):
+                win = buck_ms <= off_ms
+                prof["ddp_overlap"] = "bucketed" if win else "off"
+                rows.append((
+                    "ddp_overlap", prof["ddp_overlap"],
+                    f"A/B step ms: off {off_ms}, bucketed {buck_ms}; "
+                    f"parity_ok {ov_leg.get('parity_ok')} "
+                    f"(loss_abs_diff {ov_leg.get('loss_abs_diff')})"))
+                # overlap_fraction_<scheme> <- the WINNER's profiled
+                # exposed-comm fraction, keyed by the scheme the A/B
+                # ran under (how much wire hides depends on how many
+                # bytes are on it) — same comm_ms > 0 gate as the
+                # global overlap_measured_fraction
+                scheme = ov_leg.get("scheme")
+                wov = (buck_r if win else off_r).get("overlap")
+                if scheme in ("fp32", "bf16", "int8_blockscale") \
+                        and isinstance(wov, dict) \
+                        and "error" not in wov \
+                        and isinstance(wov.get("comm_ms"),
+                                       (int, float)) \
+                        and wov["comm_ms"] > 0 \
+                        and isinstance(
+                            wov.get("exposed_comm_fraction"),
+                            (int, float)):
+                    key = f"overlap_fraction_{scheme}"
+                    prof[key] = round(
+                        float(wov["exposed_comm_fraction"]), 4)
+                    rows.append((
+                        key, f"{prof[key]}",
+                        f"{prof['ddp_overlap']} leg's one-step "
+                        f"profiled capture: exposed "
+                        f"{wov.get('exposed_comm_ms')} ms of "
+                        f"{wov.get('comm_ms')} ms collective time"))
+
         pl = det.get("plan")
         if isinstance(pl, dict) and pl.get("_backend") in (None, "tpu") \
                 and isinstance(pl.get("plans"), list):
@@ -894,6 +1019,10 @@ def main(argv=None):
             # and any one-step profiled-capture overlap block (the
             # exposed-comm evidence must be internally consistent)
             for v in overlap_violations(art):
+                print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
+            # and the async-overlap A/B leg (parity must hold and the
+            # bucketed leg must not expose MORE wire than deferred)
+            for v in overlap_exec_violations(art):
                 print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
             # and every embedded goodput ledger (classes must partition
             # the wall exactly; replay badput iff rollbacks metered)

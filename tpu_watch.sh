@@ -151,6 +151,18 @@ SPMD_TO=${APEX_WATCH_SPMD_TO:-400}
 TL_CMD=${APEX_WATCH_TIMELINE_CMD-"python -m apex_tpu.telemetry timeline $SPMD_PROFILE --json"}
 TL_JSON=${APEX_WATCH_TIMELINE_JSON:-TIMELINE_r5.json}
 TL_TO=${APEX_WATCH_TIMELINE_TO:-120}
+# stage 2g: async-overlap execution A/B (PR 16) — the flagship dp step
+# deferred vs backward-bucketed, loss parity + metered LOGICAL bytes in
+# one artifact; the default command opens a PER-LEG one-step profiled
+# capture so the same artifact carries both exposed_comm_fraction
+# numbers (the bucketed one dropping below deferred is the on-chip
+# proof the overlap is real).  Feeds apply_perf_results' ddp_overlap /
+# overlap_fraction_<scheme> decisions.  ${VAR-default}: an explicitly
+# EMPTY override disables the stage
+OVERLAP_PROFILE=${APEX_WATCH_OVERLAP_PROFILE:-OVERLAP_PROFILE_r5}
+OVERLAP_CMD=${APEX_WATCH_OVERLAP_CMD-"APEX_BENCH_PROFILE_DIR=$OVERLAP_PROFILE python bench.py --overlap"}
+OVERLAP_JSON=${APEX_WATCH_OVERLAP_JSON:-OVERLAP_AB_r5.json}
+OVERLAP_TO=${APEX_WATCH_OVERLAP_TO:-400}
 # stage 4b: bench-trend / goodput regression watchdog (ISSUE 15) —
 # ingest the committed BENCH_r*/BENCH_TPU_r* trajectory plus any
 # GOODPUT*.json run ledgers and flag per-leg step-time/MFU/goodput
@@ -369,6 +381,21 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$TL_JSON".run
       fi
       echo "$(date +%H:%M:%S) timeline decomposition done rc=$rct" >> "$LOG"
+    fi
+    # ---- stage 2g: async-overlap execution A/B (best-effort, short) ----
+    if [ -n "$OVERLAP_CMD" ] && [ ! -s "$OVERLAP_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$OVERLAP_TO" bash -c "$OVERLAP_CMD" > "$OVERLAP_JSON".run 2>> "$LOG"
+      rco=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span overlap_ab "$t0" "$rco"
+      stage_mem
+      if [ $rco -eq 0 ] && [ -s "$OVERLAP_JSON".run ]; then
+        mv "$OVERLAP_JSON".run "$OVERLAP_JSON"
+      else
+        # a wedged/failed A/B never leaves a truncated artifact behind
+        rm -f "$OVERLAP_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) overlap_ab A/B done rc=$rco" >> "$LOG"
     fi
     # ---- stage 3a: guard-driven resumable train (incremental) ----
     # BEFORE the all-or-nothing save/resume leg: the guard leg makes
