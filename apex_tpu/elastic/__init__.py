@@ -136,6 +136,20 @@ def reshard_payload(template_state, payload: dict, saved_meta: dict,
         deterministic, and the residual sum is preserved exactly) and
         zeros the rest; the full correction rides replica 0's next
         quantized exchange;
+      * a 2-D leaf matching the layout's optional ``stacked`` block
+        (``{"rows": N, "row_total": T, "row_used": int|[int,...]}`` —
+        what a pipeline-stage / expert-shard lattice writes, one flat
+        shard per stage/expert row) whose template twin is 2-D with a
+        DIFFERENT row lattice is a **stage/expert resize**
+        (``resize@N:M``): each saved row's ``row_used`` prefix is
+        validated + stripped of its canonical zero padding through
+        :func:`~apex_tpu.parallel.collectives.rechunk_flat`, the
+        prefixes concatenate into the one canonical flat sequence, and
+        that sequence re-chunks into the live ``(rows', row_total')``
+        lattice (contiguous fill, padding only at the global tail) —
+        bitwise on every real element, round-trippable N -> M -> N.  A
+        sequence that does not FIT the live lattice is a true model
+        change and raises;
       * everything else (replicated params, scalar counters, amp
         scaler state) passes through unchanged;
       * any other shape disagreement is a real model/config change —
@@ -182,6 +196,41 @@ def reshard_payload(template_state, payload: dict, saved_meta: dict,
                     and hshape[0] == saved_total):
                 out.append(_coll.rechunk_flat(h, used=used,
                                               total=tshape[0]))
+                resharded += 1
+                continue
+            stacked = layout.get("stacked")
+            if (isinstance(stacked, dict) and len(hshape) == 2
+                    and len(tshape) == 2
+                    and hshape == (int(stacked.get("rows") or -1),
+                                   int(stacked.get("row_total") or -1))):
+                # stage/expert resize: per-row flat shards -> one
+                # canonical sequence -> the live row lattice
+                ru = stacked.get("row_used", stacked.get("row_total"))
+                used_rows = ([int(u) for u in ru]
+                             if isinstance(ru, (list, tuple))
+                             else [int(ru)] * hshape[0])
+                if len(used_rows) != hshape[0]:
+                    raise WorldSizeMismatchError(
+                        saved_world, live_world,
+                        detail=f"stacked.row_used has {len(used_rows)} "
+                               f"entries for {hshape[0]} rows")
+                rows_arr = np.asarray(h)
+                try:
+                    parts = [_coll.rechunk_flat(rows_arr[i], used=u,
+                                                total=u)
+                             for i, u in enumerate(used_rows)]
+                    flat = (np.concatenate(parts) if parts
+                            else np.zeros((0,), rows_arr.dtype))
+                    out.append(_coll.rechunk_flat(
+                        flat, used=int(flat.shape[0]),
+                        total=tshape[0] * tshape[1]).reshape(tshape))
+                except ValueError as err:
+                    # content that cannot live in the new lattice is a
+                    # real model change, not a world-size change
+                    raise WorldSizeMismatchError(
+                        saved_world, live_world,
+                        detail=f"stage/expert resize {hshape} -> "
+                               f"{tshape}: {err}")
                 resharded += 1
                 continue
             if (len(hshape) == 2 and len(tshape) == 2

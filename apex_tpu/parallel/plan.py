@@ -70,6 +70,8 @@ import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, create_mesh, use_mesh
+from .pipeline import PIPE_AXIS
+from .expert import EXPERT_AXIS
 from . import collectives as _coll
 from . import weight_update as _wu
 
@@ -108,6 +110,13 @@ DEFAULT_TIE_TOL = 0.03
 #: sequence-parallel candidates only make sense for long sequences —
 #: below this the per-layer exchange dominates any activation saving
 SP_MIN_SEQ = 2048
+
+#: expert count the ep cost model assumes when the profiled model is
+#: dense (the flagship): the MoETransformerConfig default — the expert
+#: variant the ep engine materializes (``spmd._build_ep_step`` derives
+#: its MoE config with this count, so model and engine price the same
+#: program)
+EP_DEFAULT_EXPERTS = 8
 
 #: env override for the comm model's overlap factor (the measured
 #: exposed-comm fraction) — precedence: explicit ``predict`` arg > this
@@ -180,6 +189,10 @@ class ModelProfile:
     act_layer_bytes: int = 0      # one layer's activation tensor (B*S*D*4)
     seq: int = 0
     heads: int = 1
+    global_batch: int = 0         # batch facts for the pp microbatch lattice
+    experts: int = 0              # MoE expert count (0 = dense profile; the
+                                  # ep model assumes EP_DEFAULT_EXPERTS)
+    capacity_factor: float = 1.25  # ep router capacity factor
     platform: str = "cpu"
     collective_bytes: dict = dataclasses.field(default_factory=dict)
 
@@ -206,13 +219,16 @@ def profile_step(fn, *args, name: str = "step", cfg=None,
 
     table = attrib.op_table(fn, *args, **kwargs)
     mem = tmem.memory_model(fn, *args, register=False, **kwargs)
-    layers = act_layer = seq = 0
+    layers = act_layer = seq = experts = 0
     heads = 1
+    cap_factor = 1.25
     if cfg is not None:
         layers = int(cfg.num_layers)
         seq = int(cfg.max_len)
         heads = int(cfg.num_heads)
         act_layer = int((global_batch or 1) * seq * cfg.d_model * 4)
+        experts = int(getattr(cfg, "num_experts", 0) or 0)
+        cap_factor = float(getattr(cfg, "capacity_factor", 1.25))
     coll = {
         op: {"count": agg["count"],
              "logical_bytes": agg["logical_bytes"]}
@@ -233,6 +249,8 @@ def profile_step(fn, *args, name: str = "step", cfg=None,
         constants_bytes=mem.get("constants_bytes", 0),
         peak_hbm_bytes=mem["peak_hbm_bytes"],
         layers=layers, act_layer_bytes=act_layer, seq=seq, heads=heads,
+        global_batch=int(global_batch or 0), experts=experts,
+        capacity_factor=cap_factor,
         platform=jax.devices()[0].platform,
         collective_bytes=coll,
     )
@@ -315,12 +333,16 @@ _COLL_HOPS = {
     "reduce_scatter": lambda n: n - 1,
     "all_gather": lambda n: n - 1,
     "all_to_all": lambda n: n - 1,
+    # stage-to-stage activation hop (the pp engine's wire): one neighbor
+    # link, the full payload crosses it
+    "ppermute": lambda n: 1,
 }
 _COLL_TRAFFIC = {
     "all_reduce": lambda n: 2.0 * (n - 1) / n,
     "reduce_scatter": lambda n: (n - 1) / n,
     "all_gather": lambda n: (n - 1) / n,
     "all_to_all": lambda n: (n - 1) / n,
+    "ppermute": lambda n: 1.0,
 }
 
 
@@ -414,6 +436,9 @@ class Plan:
     tp: int = 1
     sp: int = 1
     sp_strategy: str = "none"          # none | ring | ulysses
+    pp_stages: int = 1                 # GPipe stages (the pipe mesh axis)
+    pp_microbatches: int = 1           # M in-flight microbatches per replica
+    ep: int = 1                        # expert-parallel width (expert axis)
     zero: bool = False                 # contrib ZeRO optimizer route
     update_sharding: str = "off"       # off | zero1 (parallel.weight_update)
     collective_scheme: str = "fp32"    # dp gradient wire
@@ -426,7 +451,7 @@ class Plan:
 
     @property
     def chips(self) -> int:
-        return self.dp * self.tp * self.sp
+        return self.dp * self.tp * self.sp * self.pp_stages * self.ep
 
     @property
     def shards_update(self) -> bool:
@@ -436,7 +461,8 @@ class Plan:
     @property
     def complexity(self) -> int:
         """Knobs engaged — the tie-break rank (simpler wins a tie)."""
-        return ((self.tp > 1) + (self.sp > 1) + 2 * self.zero
+        return ((self.tp > 1) + (self.sp > 1) + (self.pp_stages > 1)
+                + (self.ep > 1) + 2 * self.zero
                 + (self.update_sharding != "off")
                 + (self.collective_scheme != "fp32")
                 + (self.allgather_scheme != "fp32"))
@@ -446,24 +472,30 @@ class Plan:
         """Which step engine (``parallel.spmd``) materializes this plan
         — also the one-point-calibration bucket ``bench.py --plan``
         uses: ``zero`` (contrib ZeRO) / ``tp`` (consistent-SPMD GSPMD
-        jit) / ``sp`` (ring/ulysses shard_map) / ``dp`` (the classic
-        DDP harness)."""
+        jit) / ``sp`` (ring/ulysses shard_map) / ``pp`` (GPipe
+        microbatched stages) / ``ep`` (switch-MoE expert sharding) /
+        ``dp`` (the classic DDP harness)."""
         if self.zero:
             return "zero"
         if self.tp > 1:
             return "tp"
         if self.sp > 1:
             return "sp"
+        if self.pp_stages > 1:
+            return "pp"
+        if self.ep > 1:
+            return "ep"
         return "dp"
 
     @property
     def measurable(self) -> bool:
         """Can ``bench.py --plan`` time this plan?  True across the
         whole search space since the ``parallel.spmd`` step engine
-        (ISSUE 12): every family — dp, dp x tp (GSPMD), dp x sp
-        (ring/ulysses), contrib-ZeRO — materializes as a runnable step
+        (ISSUE 12; pp/ep families ISSUE 17): every family — dp, dp x tp
+        (GSPMD), dp x sp (ring/ulysses), dp x pp (GPipe), dp x ep
+        (switch-MoE), contrib-ZeRO — materializes as a runnable step
         via :func:`~apex_tpu.parallel.spmd.build_plan_step`."""
-        return self.family in ("dp", "tp", "sp", "zero")
+        return self.family in ("dp", "tp", "sp", "zero", "pp", "ep")
 
     def axis_sizes(self) -> Dict[str, int]:
         """``create_mesh`` axis dict — size-1 axes are omitted (except
@@ -474,12 +506,19 @@ class Plan:
             axes[MODEL_AXIS] = self.tp
         if self.sp > 1:
             axes[SEQ_AXIS] = self.sp
+        if self.pp_stages > 1:
+            axes[PIPE_AXIS] = self.pp_stages
+        if self.ep > 1:
+            axes[EXPERT_AXIS] = self.ep
         return axes
 
     def knobs(self) -> dict:
         return {
             "dp": self.dp, "tp": self.tp, "sp": self.sp,
-            "sp_strategy": self.sp_strategy, "zero": self.zero,
+            "sp_strategy": self.sp_strategy,
+            "pp_stages": self.pp_stages,
+            "pp_microbatches": self.pp_microbatches,
+            "ep": self.ep, "zero": self.zero,
             "update_sharding": self.update_sharding,
             "collective_scheme": self.collective_scheme,
             "allgather_scheme": self.allgather_scheme,
@@ -538,6 +577,10 @@ class Plan:
             bits.append(f"tp={self.tp}")
         if self.sp > 1:
             bits.append(f"sp={self.sp}:{self.sp_strategy}")
+        if self.pp_stages > 1:
+            bits.append(f"pp={self.pp_stages}x{self.pp_microbatches}")
+        if self.ep > 1:
+            bits.append(f"ep={self.ep}")
         if self.zero:
             bits.append("zero")
         if self.update_sharding != "off":
@@ -559,25 +602,62 @@ def default_plan(chips: int) -> Plan:
 # prediction: step time + HBM per replica for one candidate
 # ---------------------------------------------------------------------------
 
+def _ep_geometry(profile: ModelProfile, dp: int, ep: int,
+                 sp: int = 1) -> Tuple[int, int, int, int]:
+    """(E_total, capacity, d_model, tokens_local) of the ep router under
+    the plan's axes — the shapes the capacity-factored all_to_all and
+    the per-device expert buffers are built from (``parallel.expert``'s
+    own formulas, so model and engine agree)."""
+    E = int(profile.experts or EP_DEFAULT_EXPERTS)
+    gb = max(int(profile.global_batch or 1), 1)
+    seq = max(int(profile.seq), 1)
+    tokens_local = max(gb * seq // max(dp * ep * sp, 1), 1)
+    capacity = max(int(profile.capacity_factor * tokens_local / E), 1)
+    d_model = max(int(profile.act_layer_bytes) // max(gb * seq * 4, 1), 1)
+    return E, capacity, d_model, tokens_local
+
+
 def plan_hbm_bytes(profile: ModelProfile, plan: Plan) -> Tuple[int, dict]:
     """Per-replica HBM at the peak under the plan's axes, scaled from
     ``memory_model()``'s per-class partition: params/optimizer shard
-    over tp (and optimizer additionally over dp when the update is
-    sharded — the ``update_sharding_world`` semantics); activations and
-    temps shard over every axis; the batch over dp x sp.  args and
-    constants replicate."""
+    over tp x pp (pipeline stages each own their layer slice; and
+    optimizer additionally over dp when the update is sharded — the
+    ``update_sharding_world`` semantics); activations and temps shard
+    over every token/layer axis; the batch over dp x sp x ep.  args and
+    constants replicate.
+
+    pp adds the GPipe schedule stash (``pp_stash``): the fori_loop
+    backward saves one microbatch activation block per tick (M + S - 1
+    ticks) plus the M-deep output collection buffer — the "M in-flight
+    microbatches" memory the bubble buys throughput with.  ep adds the
+    per-device expert-capacity buffers (``ep_buffers``): the dense
+    dispatch/combine one-hots (T, E, C) and the owner-major all_to_all
+    queues (E, C, D), both ways — the static shapes switch routing pays
+    for XLA-friendliness."""
     dp, tp, sp = plan.dp, plan.tp, plan.sp
-    opt_div = tp * (dp if plan.shards_update else 1)
+    pp, ep = plan.pp_stages, plan.ep
+    opt_div = tp * pp * (dp if plan.shards_update else 1)
     by = {
-        "params": profile.params_bytes // tp,
+        "params": profile.params_bytes // (tp * pp),
         "optimizer": profile.optimizer_bytes // opt_div,
-        "activations": profile.activations_bytes // (dp * tp * sp),
-        "batch": profile.batch_bytes // (dp * sp),
-        "temps": profile.temps_bytes // (dp * tp * sp),
-        "output": profile.output_bytes // dp,
+        "activations": profile.activations_bytes // (dp * tp * sp * pp * ep),
+        "batch": profile.batch_bytes // (dp * sp * ep),
+        "temps": profile.temps_bytes // (dp * tp * sp * ep),
+        "output": profile.output_bytes // (dp * ep),
         "args": profile.args_bytes,
         "constants": profile.constants_bytes,
     }
+    if pp > 1:
+        m = max(int(plan.pp_microbatches), 1)
+        ticks = m + pp - 1
+        blk = profile.act_layer_bytes // max(dp * m, 1)
+        by["pp_stash"] = int((ticks + m) * blk)
+    if ep > 1:
+        e_total, cap, d_model, t_local = _ep_geometry(profile, dp, ep, sp)
+        # dispatch + combine one-hots and both all_to_all queue buffers,
+        # fp32 (moe_ffn computes routing in f32)
+        by["ep_buffers"] = int(4 * (2 * t_local * e_total * cap
+                                    + 2 * e_total * cap * d_model))
     return sum(by.values()), by
 
 
@@ -605,13 +685,14 @@ def predict(profile: ModelProfile, plan: Plan, ceilings=None,
         overlap_fraction,
         scheme=(plan.collective_scheme if plan.family == "dp" else None))
     dp, tp, sp = plan.dp, plan.tp, plan.sp
-    shards = dp * tp * sp
+    pp, ep = plan.pp_stages, plan.ep
+    shards = dp * tp * sp * pp * ep
 
     f_upd, b_upd = _update_costs(profile)
     t_train = compute_time_s((profile.flops - f_upd) / shards,
                              (profile.bytes_accessed - b_upd) / shards,
                              ceil)
-    upd_div = tp * (dp if plan.shards_update else 1)
+    upd_div = tp * pp * (dp if plan.shards_update else 1)
     t_update = compute_time_s(f_upd / upd_div, b_upd / upd_div, ceil)
 
     t_dp = 0.0
@@ -658,12 +739,47 @@ def predict(profile: ModelProfile, plan: Plan, ceilings=None,
             t_sp = 2 * max(profile.layers, 1) * collective_time_s(
                 "all_gather", 2 * act / sp, sp, ceil)
 
+    t_bubble = t_pp = 0.0
+    if pp > 1:
+        m = max(int(plan.pp_microbatches), 1)
+        # GPipe fill-drain: the schedule runs M + S - 1 ticks for M
+        # microbatches of useful work — the (S-1)/M bubble sits on the
+        # critical path (no overlap can hide it; it IS idle hardware)
+        t_bubble = t_train * (pp - 1) / m
+        # one microbatch activation block hops stage-to-stage per tick,
+        # forward + the mirrored backward
+        blk = profile.act_layer_bytes / max(dp * m, 1)
+        t_pp = 2 * (m + pp - 1) * collective_time_s("ppermute", blk, pp,
+                                                    ceil)
+
+    t_ep = 0.0
+    if ep > 1:
+        coll = (profile.collective_bytes or {}).get("all-to-all")
+        if coll and coll.get("logical_bytes"):
+            # compiled-HLO sub-table where available: the program's own
+            # per-device all_to_all payload (fwd count; backward mirrors)
+            count = max(int(coll.get("count", 1)), 1)
+            t_ep = 2 * count * collective_time_s(
+                "all_to_all", float(coll["logical_bytes"]) / count, ep,
+                ceil)
+        else:
+            # capacity-factored router wire: each device ships its
+            # owner-major (E_total * capacity, D) queue both ways per
+            # MoE layer, forward + the mirrored backward (4 all_to_alls
+            # per layer per step)
+            e_total, cap, d_model, _ = _ep_geometry(profile, dp, ep, sp)
+            a2a = 4.0 * e_total * cap * d_model
+            t_ep = 4 * max(profile.layers, 1) * collective_time_s(
+                "all_to_all", a2a, ep, ceil)
+
     # only the dp wire is overlap-eligible: its collectives are the
     # ones the backward can hide (bucket-by-bucket as grads become
-    # ready); tp/sp exchanges sit ON the critical path between layer
-    # ops, so they stay fully charged
+    # ready); tp/sp/pp/ep exchanges sit ON the critical path between
+    # layer ops, so they stay fully charged — and the pipeline bubble
+    # is idle hardware by construction
     t_dp_exposed = t_dp * overlap
-    total_s = t_train + t_update + t_dp_exposed + t_tp + t_sp
+    total_s = (t_train + t_update + t_dp_exposed + t_tp + t_sp
+               + t_bubble + t_pp + t_ep)
     hbm, by = plan_hbm_bytes(profile, plan)
     plan.predicted_step_ms = total_s * 1e3
     plan.predicted_hbm_bytes = int(hbm)
@@ -675,6 +791,9 @@ def predict(profile: ModelProfile, plan: Plan, ceilings=None,
         "overlap_fraction": overlap,
         "tp_comm_ms": t_tp * 1e3,
         "sp_comm_ms": t_sp * 1e3,
+        "pp_bubble_ms": t_bubble * 1e3,
+        "pp_comm_ms": t_pp * 1e3,
+        "ep_comm_ms": t_ep * 1e3,
     }
     plan.feasible = hbm <= ceil["hbm_bytes"]
     return plan
@@ -685,56 +804,102 @@ def predict(profile: ModelProfile, plan: Plan, ceilings=None,
 # ---------------------------------------------------------------------------
 
 def _factorizations(chips: int):
-    """(dp, tp, sp) triples with dp*tp*sp == chips (sp last so the
-    dp x tp plane enumerates first)."""
+    """(dp, tp, sp, pp, ep) tuples with dp*tp*sp*pp*ep == chips (the
+    classic dp x tp plane enumerates first; pp then ep widen last)."""
     chips = int(chips)
-    for sp in range(1, chips + 1):
-        if chips % sp:
+    for ep in range(1, chips + 1):
+        if chips % ep:
             continue
-        rest = chips // sp
-        for tp in range(1, rest + 1):
-            if rest % tp:
+        r1 = chips // ep
+        for pp in range(1, r1 + 1):
+            if r1 % pp:
                 continue
-            yield rest // tp, tp, sp
+            r2 = r1 // pp
+            for sp in range(1, r2 + 1):
+                if r2 % sp:
+                    continue
+                rest = r2 // sp
+                for tp in range(1, rest + 1):
+                    if rest % tp:
+                        continue
+                    yield rest // tp, tp, sp, pp, ep
+
+
+def _pp_microbatch_options(profile: ModelProfile, dp: int) -> List[int]:
+    """Candidate microbatch counts M for a pp plan at ``dp`` replicas:
+    divisors of the per-replica batch (the engine reshapes (B_local,
+    ...) -> (M, B_local/M, ...)), capped at 8 — beyond that the bubble
+    saving per extra M is <2% while the per-microbatch blocks shrink
+    below MXU-friendly shapes."""
+    b_rep = int(profile.global_batch or 0) // max(dp, 1)
+    if b_rep < 1:
+        return []
+    return [m for m in (1, 2, 4, 8) if m <= b_rep and b_rep % m == 0]
 
 
 def enumerate_plans(profile: ModelProfile, chips: int, *,
                     ceilings=None, platform: Optional[str] = None,
                     schemes: Sequence[str] = PLAN_SCHEMES,
                     allow_tp: bool = True, allow_sp: bool = True,
+                    allow_pp: bool = True, allow_ep: bool = True,
                     sp_min_seq: int = SP_MIN_SEQ) -> List[Plan]:
     """Every candidate in the space, predicted (feasible and infeasible
     alike — :func:`search` prunes).  Structural constraints: tp only
     for layered models and only up to the head count (the attention
     shard unit); sp only for sequences >= ``sp_min_seq``, dividing the
-    sequence, composed with dp only (the repo's SP paths); schemes and
-    update-sharding variants only where a dp wire exists (dp > 1)."""
+    sequence, composed with dp only (the repo's SP paths); pp only when
+    the stage count divides the layer stack and a microbatch lattice
+    exists (M divides the per-replica batch), composed with dp only; ep
+    only when the width divides the expert count, composed with dp
+    only; schemes and update-sharding variants only where a dp wire
+    exists (dp > 1)."""
     ceil = _resolve_ceil(ceilings, platform or profile.platform)
     plans: List[Plan] = []
-    for dp, tp, sp in _factorizations(chips):
+    for dp, tp, sp, pp, ep in _factorizations(chips):
         if tp > 1 and (not allow_tp or profile.layers <= 0
                        or tp > profile.heads):
             continue
         if sp > 1:
             if (not allow_sp or profile.seq < sp_min_seq
-                    or profile.seq % sp or tp > 1):
+                    or profile.seq % sp or tp > 1 or pp > 1 or ep > 1):
                 continue
             strategies = ["ring"]
             if profile.heads % sp == 0:
                 strategies.append("ulysses")
         else:
             strategies = ["none"]
+        micro_opts = [1]
+        if pp > 1:
+            # GPipe stages partition the stacked layer axis; the engine
+            # composes pp with dp only (one stage slice per pipe device)
+            if (not allow_pp or profile.layers <= 0 or pp > profile.layers
+                    or profile.layers % pp or tp > 1 or sp > 1 or ep > 1):
+                continue
+            micro_opts = _pp_microbatch_options(profile, dp)
+            if not micro_opts:
+                continue
+        if ep > 1:
+            # expert width must divide the expert count (the dense
+            # flagship's ep variant assumes EP_DEFAULT_EXPERTS); the
+            # engine composes ep with dp only
+            e_total = int(profile.experts or EP_DEFAULT_EXPERTS)
+            if (not allow_ep or profile.layers <= 0 or e_total % ep
+                    or tp > 1 or sp > 1 or pp > 1):
+                continue
         # sharding variants: plain DDP; update-sharded DDP (zero1); the
         # contrib-ZeRO route.  The wire scheme only matters with a dp
         # axis to exchange over.  Engine constraints (parallel.spmd):
         # contrib ZeRO is a shard_map-over-data optimizer — it composes
-        # with neither the GSPMD tp step nor the (data, seq) sp step;
-        # and the tp family's dp wire is XLA-owned (consistent-SPMD:
-        # collectives by annotation), so compressed schemes don't
-        # apply there — a plan the engine cannot run must not be
-        # enumerated, let alone ranked.
+        # with neither the GSPMD tp step nor the (data, seq) sp step
+        # nor the pp/ep shard_map engines; the tp family's dp wire is
+        # XLA-owned (consistent-SPMD: collectives by annotation), so
+        # compressed schemes don't apply there; and the pp/ep engines
+        # run the plain fused-flat update (their stage/expert-local
+        # param trees don't fit zero1's replicated-state lattice) — a
+        # plan the engine cannot run must not be enumerated, let alone
+        # ranked.
         variants = [("off", False)]
-        if dp > 1:
+        if dp > 1 and pp == 1 and ep == 1:
             variants.append(("zero1", False))
             if tp == 1 and sp == 1:
                 variants.append(("off", True))
@@ -742,10 +907,12 @@ def enumerate_plans(profile: ModelProfile, chips: int, *,
         for strat in strategies:
             for scheme in dp_schemes:
                 for us, zero in variants:
-                    plans.append(predict(profile, Plan(
-                        dp=dp, tp=tp, sp=sp, sp_strategy=strat,
-                        zero=zero, update_sharding=us,
-                        collective_scheme=scheme), ceilings=ceil))
+                    for m in micro_opts:
+                        plans.append(predict(profile, Plan(
+                            dp=dp, tp=tp, sp=sp, sp_strategy=strat,
+                            pp_stages=pp, pp_microbatches=m, ep=ep,
+                            zero=zero, update_sharding=us,
+                            collective_scheme=scheme), ceilings=ceil))
     return plans
 
 
@@ -885,6 +1052,7 @@ def build_flagship_step(cfg, mesh, *, global_batch: int,
 #: :func:`from_tuning` consumes) — kept in one place so the two ends of
 #: the loop cannot drift
 TUNING_KEYS = ("plan_dp", "plan_tp", "plan_sp", "plan_sp_strategy",
+               "plan_pp_stages", "plan_pp_microbatches", "plan_ep",
                "plan_zero", "plan_update_sharding",
                "plan_collective_scheme", "plan_allgather_scheme")
 
@@ -929,6 +1097,9 @@ def from_tuning(chips: Optional[int] = None, *,
     plan = Plan(
         dp=int(dp), tp=int(get("plan_tp", 1)), sp=int(get("plan_sp", 1)),
         sp_strategy=get("plan_sp_strategy", "none"),
+        pp_stages=int(get("plan_pp_stages", 1) or 1),
+        pp_microbatches=int(get("plan_pp_microbatches", 1) or 1),
+        ep=int(get("plan_ep", 1) or 1),
         zero=bool(get("plan_zero", False)),
         update_sharding=get("plan_update_sharding", "off"),
         collective_scheme=get("plan_collective_scheme", "fp32"),
@@ -1000,6 +1171,9 @@ def _plans_from_artifact(art: dict) -> Tuple[List[Plan], Dict[int, float]]:
         plans.append(Plan(
             dp=kn.get("dp", 1), tp=kn.get("tp", 1), sp=kn.get("sp", 1),
             sp_strategy=kn.get("sp_strategy", "none"),
+            pp_stages=kn.get("pp_stages", 1),
+            pp_microbatches=kn.get("pp_microbatches", 1),
+            ep=kn.get("ep", 1),
             zero=kn.get("zero", False),
             update_sharding=kn.get("update_sharding", "off"),
             collective_scheme=kn.get("collective_scheme", "fp32"),

@@ -42,6 +42,31 @@ per plan family, all behind :func:`build_plan_step`:
     :class:`~apex_tpu.contrib.optimizers.DistributedFusedAdam` route —
     permanently sharded optimizer state, the reduce-scatter /
     allgather wire riding the plan's collective scheme.
+``pp`` (pp_stages > 1) — ISSUE 17
+    shard_map over (data, pipe): the flagship's stacked layer axis is
+    partitioned into S stage slices (one per pipe device, each running
+    its local layers under a mini-scan), microbatches stream through
+    :func:`~apex_tpu.parallel.pipeline.pipeline_apply`'s fill-drain
+    ``ppermute`` schedule, and the embed/head run masked on the last
+    stage so the tied-embedding grad is counted exactly once (psum over
+    the pipe axis reassembles every dense grad).  Each stage keeps its
+    OWN fused-flat Adam over its local param tree (per-stage optimizer
+    placement on the lane lattice) with the amp overflow-skip select
+    guarding its fp32 master.  The fori_loop schedule hides the
+    ``ppermute``s from the compiled-HLO entry walk, so the wire is
+    metered from the STATIC schedule (:func:`_pp_schedule_bytes`) —
+    2(M + S - 1) hops of one microbatch activation block.
+``ep`` (ep > 1) — ISSUE 17
+    shard_map over (data, expert): the MoE flagship variant
+    (``models.moe_transformer``) with expert FFN weights sharded on
+    their leading axis, token routing through ``parallel/expert``'s
+    capacity-factored ``all_to_all``.  Dense grads fold over the expert
+    axis first (each device's loss covers only its token shard) then
+    ride the normal DDP wire over data; expert grads are excluded from
+    that dense fold — they are already per-expert-local — and take only
+    the data-axis reduction.  The ``ep.all_to_all`` wire is metered
+    from the compiled HLO (the python-loop layers keep it in the entry
+    computation) with the static schedule as the cross-check.
 
 amp O-level master weights: every fused-flat engine keeps the fp32
 master buffer authoritative; ``amp_dtype="bfloat16"`` runs the model
@@ -66,7 +91,7 @@ __all__ = ["build_plan_step", "plan_param_pspecs", "compiled_collectives",
            "meter_compiled_collectives", "SPMD_FAMILIES"]
 
 #: plan families the engine materializes (Plan.family values)
-SPMD_FAMILIES = ("dp", "tp", "sp", "zero")
+SPMD_FAMILIES = ("dp", "tp", "sp", "zero", "pp", "ep")
 
 
 def plan_param_pspecs(cfg, plan):
@@ -114,6 +139,7 @@ _METER_OPS = {
     "tp": {"all-reduce": ("tp", "psum")},
     "sp": {"all-to-all": ("sp", "all_to_all"),
            "collective-permute": ("sp", "ppermute")},
+    "ep": {"all-to-all": ("ep", "all_to_all")},
 }
 
 
@@ -137,6 +163,41 @@ def _sp_schedule_bytes(cfg, strategy: str, n_dp: int, n_sp: int,
     return {"op": "ppermute",
             "logical_bytes": 4 * layers * n_sp * blk,
             "per_layer_block_bytes": blk, "layers": layers}
+
+
+def _pp_schedule_bytes(cfg, n_dp: int, n_pp: int, microbatches: int,
+                       global_batch: int) -> dict:
+    """Static per-device wire bytes of one pp train step — the engine's
+    exact ``ppermute`` schedule (the fori_loop body hides it from the
+    compiled-HLO entry walk): the fill-drain schedule runs M + S - 1
+    ticks, each hopping one microbatch activation block (B_local/M, S,
+    D) to the next stage, and the reversed backward mirrors every hop."""
+    import jax.numpy as jnp
+    esize = jnp.dtype(cfg.dtype).itemsize
+    blk = ((global_batch // n_dp) // microbatches
+           * cfg.max_len * cfg.d_model * esize)
+    ticks = microbatches + n_pp - 1
+    return {"op": "ppermute", "logical_bytes": 2 * ticks * blk,
+            "per_tick_block_bytes": blk, "ticks": ticks}
+
+
+def _ep_schedule_bytes(cfg, n_dp: int, n_ep: int, global_batch: int) -> dict:
+    """Static per-device wire bytes of one ep train step — the
+    capacity-factored router exchange: each MoE layer ships the
+    owner-major (E_total * capacity, D) queue out and back (2
+    all_to_alls forward), mirrored in backward (4 per layer per step).
+    Unlike pp's fori_loop schedule, the python-loop MoE layers keep
+    every all_to_all in the compiled entry computation, so this static
+    schedule is the engine-independent CROSS-CHECK of the compiled-HLO
+    sub-table (which is what gets metered)."""
+    tokens_local = (global_batch // (n_dp * n_ep)) * cfg.max_len
+    capacity = max(int(cfg.capacity_factor * tokens_local
+                       / cfg.num_experts), 1)
+    blk = 4 * cfg.num_experts * capacity * cfg.d_model  # f32 queue buffer
+    layers = max(int(cfg.num_layers), 1)
+    return {"op": "all_to_all", "logical_bytes": 4 * layers * blk,
+            "per_layer_block_bytes": blk, "layers": layers,
+            "capacity": capacity}
 
 
 def meter_compiled_collectives(by_opcode: dict, family: str,
@@ -191,6 +252,10 @@ def build_plan_step(cfg, mesh, plan, *, global_batch: int, lr: float = 1e-2,
                                  amp_dtype, meter)
     if plan.sp > 1:
         return _build_sp_step(cfg, mesh, plan, global_batch, lr, meter)
+    if plan.pp_stages > 1:
+        return _build_pp_step(cfg, mesh, plan, global_batch, lr, meter)
+    if plan.ep > 1:
+        return _build_ep_step(cfg, mesh, plan, global_batch, lr, meter)
     from .plan import build_flagship_step
     # async overlap execution rides the dp engine: resolve the ambient
     # mode here (env APEX_TPU_OVERLAP / tuning ddp_overlap — what
@@ -396,6 +461,342 @@ def _build_sp_step(cfg, mesh, plan, global_batch, lr, meter):
             wire_bytes=sched["logical_bytes"], scheme="fp32",
             dtype=str(jnp.dtype(cfg.dtype)), op=sched["op"],
             family="sp")
+
+    def step(carry, tokens):
+        params, state = carry
+        params, state, loss = step_sm(params, state, tokens)
+        return (params, state), loss
+
+    return (params0, state0), step, info
+
+
+def _build_pp_step(cfg, mesh, plan, global_batch, lr, meter):
+    """The pipeline-parallel engine: shard_map over (data, pipe), the
+    flagship's stacked layer axis partitioned into one stage slice per
+    pipe device, microbatches streamed through ``pipeline_apply``'s
+    fill-drain ppermute schedule.  The embed/head run MASKED on the
+    last stage so every dense grad (including the tied-embedding head
+    term) is produced exactly once and reassembled by one pipe-axis
+    psum; each stage runs its own fused-flat Adam over its local param
+    tree (per-stage optimizer placement) with the amp overflow-skip
+    select guarding its fp32 master."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..contrib.xentropy import softmax_xentropy_loss
+    from ..models import transformer_init
+    from ..models.transformer import _layer
+    from ..normalization.fused_layer_norm import fused_layer_norm_affine
+    from ..optimizers import FusedAdam
+    from ..utils.pallas import _to_varying
+    from .distributed import DistributedDataParallel
+    from .mesh import shard_map
+    from .pipeline import PIPE_AXIS, pipeline_apply, unstack_local
+
+    n_dp = int(mesh.shape[DATA_AXIS])
+    n_pp = int(mesh.shape.get(PIPE_AXIS, 1))
+    m_micro = max(int(plan.pp_microbatches), 1)
+    n_layers = int(cfg.num_layers)
+    if n_pp <= 1:
+        raise ValueError("pp plan needs a pipe mesh axis of size >= 2")
+    if n_layers % n_pp:
+        raise ValueError(f"num_layers {n_layers} must divide into "
+                         f"{n_pp} pipeline stages")
+    if global_batch % n_dp:
+        raise ValueError(f"global batch {global_batch} must divide over "
+                         f"the data axis ({n_dp})")
+    b_local = global_batch // n_dp
+    if b_local % m_micro:
+        raise ValueError(f"per-replica batch {b_local} must divide into "
+                         f"{m_micro} microbatches")
+    if plan.shards_update or plan.zero:
+        raise ValueError("the pp engine runs the plain fused-flat update "
+                         "(no zero/zero1 composition)")
+    l_local = n_layers // n_pp
+
+    params0 = transformer_init(jax.random.PRNGKey(0), cfg)
+    # (L, ...) stacked layers -> (S, L/S, ...): P(pipe) on the stage
+    # axis gives each device its contiguous layer slice, in order
+    params0 = dict(params0)
+    params0["layers"] = jax.tree_util.tree_map(
+        lambda l: l.reshape((n_pp, l_local) + l.shape[1:]),
+        params0["layers"])
+    opt = FusedAdam(lr=lr, impl="fused")
+    ddp = DistributedDataParallel(axis_name=DATA_AXIS)
+    pspec = {
+        "embed": jax.tree_util.tree_map(lambda _: P(), params0["embed"]),
+        "layers": jax.tree_util.tree_map(lambda _: P(PIPE_AXIS),
+                                         params0["layers"]),
+        "head": jax.tree_util.tree_map(lambda _: P(), params0["head"]),
+    }
+    # per-stage optimizer: state shapes come from the LOCAL tree (one
+    # stage slice), flat m/v/master concatenate over the pipe axis
+    local_template = dict(params0)
+    local_template["layers"] = jax.tree_util.tree_map(
+        lambda l: l[:1], params0["layers"])
+    state_shape = jax.eval_shape(opt.init, local_template)
+    sspec = jax.tree_util.tree_map(
+        lambda x: P(PIPE_AXIS) if getattr(x, "ndim", 0) >= 1 else P(),
+        state_shape)
+
+    def stage_fn(lp, h):
+        def lbody(c, layer_p):
+            return _layer(c, layer_p, cfg, None, None), None
+        h, _ = jax.lax.scan(lbody, h, lp)
+        return h
+
+    def local_loss(p, tokens):
+        idx = jax.lax.axis_index(PIPE_AXIS)
+        dt = cfg.dtype
+        emb = p["embed"]
+        x = (emb["tok"][tokens].astype(dt)
+             + emb["pos"][: tokens.shape[1]][None].astype(dt))
+        x = fused_layer_norm_affine(x, emb["ln_g"].astype(dt),
+                                    emb["ln_b"].astype(dt), (cfg.d_model,))
+        xm = x.reshape(m_micro, b_local // m_micro, cfg.max_len,
+                       cfg.d_model)
+        out = pipeline_apply(stage_fn, unstack_local(p["layers"]), xm,
+                             axis_name=PIPE_AXIS)
+        x = out.reshape(b_local, cfg.max_len, cfg.d_model)
+        # head + loss run masked on the LAST stage only: every stage
+        # holds the replicated pipeline output, and an unmasked head
+        # would produce the tied-embedding logit grad once per stage —
+        # the pipe psum in grads_of would then overcount it S-fold
+        last = idx == n_pp - 1
+        x = jnp.where(last, x, jnp.zeros_like(x))
+        hd = p["head"]
+        x = fused_layer_norm_affine(x, hd["ln_g"].astype(dt),
+                                    hd["ln_b"].astype(dt), (cfg.d_model,))
+        w_out = (emb["tok"].T if cfg.tie_embeddings
+                 else hd["out"]).astype(dt)
+        logits = jnp.einsum("bsd,dv->bsv", x, w_out)
+        B, S, V = logits.shape
+        nll = softmax_xentropy_loss(logits.reshape(B * S, V),
+                                    tokens.reshape(B * S),
+                                    0.0, -1, False,
+                                    cfg.xent_impl).reshape(B, S)
+        loss = jnp.where(last, nll.mean(), 0.0)
+        return jax.lax.psum(loss, PIPE_AXIS)
+
+    def grads_of(params, tokens):
+        pv = jax.tree_util.tree_map(
+            lambda p: _to_varying(p, (DATA_AXIS, PIPE_AXIS)), params)
+        loss, grads = jax.value_and_grad(
+            lambda p: local_loss(p, tokens))(pv)
+        # dense grads are stage-masked partials (embed injection on
+        # stage 0 + tied-head term on the last stage; head on the last
+        # stage only) — one pipe psum reassembles each exactly once.
+        # Stage-local layer grads take no pipe reduction: each device's
+        # slice IS its stage's gradient.
+        grads = dict(grads)
+        for k in ("embed", "head"):
+            grads[k] = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, PIPE_AXIS), grads[k])
+        return jax.lax.pmean(loss, DATA_AXIS), grads
+
+    def body(params, state, tokens):
+        loss, grads = grads_of(params, tokens)
+        grads = ddp.allreduce_grads(grads)
+        fl = opt.flattener_for(params)
+        flat = fl.flatten(grads)
+        ok = jnp.all(jnp.isfinite(flat)).astype(jnp.float32)
+        new_state = opt.step_flat(state, flat)
+        new_state = jax.tree_util.tree_map(
+            lambda nw, old: jnp.where(ok > 0, nw, old), new_state, state)
+        return fl.unflatten(new_state.master, like=params), new_state, loss
+
+    # check off: check_rep cannot infer the fori_loop carry's
+    # replication through pipeline_apply's ppermute (the same posture
+    # as tests/L0/test_pipeline_parallel.py, prescribed by its error)
+    init_s = jax.jit(shard_map(lambda p: opt.init(p), mesh=mesh,
+                               in_specs=(pspec,), out_specs=sspec,
+                               check_vma=False))
+    step_sm = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(pspec, sspec, P(DATA_AXIS)),
+        out_specs=(pspec, sspec, P()), check_vma=False))
+    state0 = init_s(params0)
+
+    ticks = m_micro + n_pp - 1
+    info = {"family": plan.family, "engine": "shard_map.pp",
+            "dp": n_dp, "pp": n_pp, "microbatches": m_micro,
+            "stages_layers": l_local,
+            "pipeline_bubble_fraction": (n_pp - 1) / ticks}
+    # a guarded pp run's goodput ledger carves the static fill/drain
+    # share of each step span into its ``pipeline_bubble`` class —
+    # feed the running ledger at build time (no-op when none installed)
+    from ..telemetry import goodput as _goodput
+    led = _goodput.get_ledger()
+    if led is not None:
+        led.set_pipeline_bubble(info["pipeline_bubble_fraction"])
+    if meter:
+        import jax.numpy as _jnp
+        from ..telemetry import events as _tel_events
+        tokens0 = _jnp.zeros((global_batch, cfg.max_len), _jnp.int32)
+        info["collectives"] = compiled_collectives(
+            step_sm, params0, state0, tokens0)
+        # the ppermute schedule lives inside the fori_loop, invisible
+        # to the entry-computation walk — meter the engine's exact
+        # static schedule (pp.ppermute), like the sp engine does
+        sched = _pp_schedule_bytes(cfg, n_dp, n_pp, m_micro, global_batch)
+        info["pp_wire"] = sched
+        _tel_events.record_collective(
+            PIPE_AXIS, sched["logical_bytes"], 2 * sched["ticks"], 0.0,
+            wire_bytes=sched["logical_bytes"], scheme="fp32",
+            dtype=str(_jnp.dtype(cfg.dtype)), op=sched["op"],
+            family="pp")
+
+    def step(carry, tokens):
+        params, state = carry
+        params, state, loss = step_sm(params, state, tokens)
+        return (params, state), loss
+
+    return (params0, state0), step, info
+
+
+def _moe_cfg_from(cfg, n_ep: int):
+    """The MoE flagship variant an ep plan materializes: the dense
+    config's dims with ``EP_DEFAULT_EXPERTS`` switch experts (rounded
+    up to a multiple of the expert-axis width) — already-MoE configs
+    pass through untouched."""
+    from ..models.moe_transformer import MoETransformerConfig
+    if isinstance(cfg, MoETransformerConfig):
+        return cfg
+    from .plan import EP_DEFAULT_EXPERTS
+    experts = max(EP_DEFAULT_EXPERTS, n_ep)
+    if experts % n_ep:
+        experts = n_ep * (experts // n_ep + 1)
+    return MoETransformerConfig(
+        vocab_size=cfg.vocab_size, max_len=cfg.max_len,
+        num_layers=cfg.num_layers, d_model=cfg.d_model,
+        num_heads=cfg.num_heads, d_ff=cfg.d_ff, num_experts=experts,
+        causal=cfg.causal, dtype=cfg.dtype,
+        xent_impl=getattr(cfg, "xent_impl", "auto"))
+
+
+def _is_expert_leaf(path) -> bool:
+    """Expert-sharded leaves of the MoE param tree: the per-layer
+    ``w_in``/``w_out`` FFN stacks (leading expert axis).  The router is
+    dense — every device routes over the FULL expert width."""
+    last = path[-1]
+    name = getattr(last, "key", None)
+    return name in ("w_in", "w_out")
+
+
+def _build_ep_step(cfg, mesh, plan, global_batch, lr, meter):
+    """The expert-parallel engine: shard_map over (data, expert), the
+    MoE flagship variant with expert FFN weights sharded on their
+    leading axis and token routing through ``parallel/expert``'s
+    capacity-factored all_to_all.  Dense grads fold over the expert
+    axis (each device's loss covers only its token shard) then ride
+    the normal DDP wire over data; expert grads are EXCLUDED from that
+    dense fold — the backward all_to_all already delivered every
+    peer's contribution to the owning shard — and take only the mean
+    scaling + the data-axis reduction.  ``n_ep == 1`` degrades to the
+    dp-MoE baseline (full expert set per device, no exchange): the A/B
+    leg's loss-parity oracle."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..models.moe_transformer import (moe_transformer_init,
+                                          moe_transformer_loss)
+    from ..optimizers import FusedAdam
+    from ..utils.pallas import has_vma, _to_varying
+    from .distributed import DistributedDataParallel
+    from .expert import EXPERT_AXIS
+    from .mesh import shard_map
+
+    n_dp = int(mesh.shape[DATA_AXIS])
+    n_ep = int(mesh.shape.get(EXPERT_AXIS, 1))
+    cfg_moe = _moe_cfg_from(cfg, max(n_ep, 1))
+    if cfg_moe.num_experts % max(n_ep, 1):
+        raise ValueError(f"{cfg_moe.num_experts} experts must divide over "
+                         f"the expert axis ({n_ep})")
+    world = n_dp * n_ep
+    if global_batch % world:
+        raise ValueError(f"global batch {global_batch} must divide over "
+                         f"the data x expert axes ({world})")
+    if plan.shards_update or plan.zero:
+        raise ValueError("the ep engine runs the plain fused-flat update "
+                         "(no zero/zero1 composition)")
+
+    params0 = moe_transformer_init(jax.random.PRNGKey(0), cfg_moe,
+                                   n_expert_shards=1)
+    opt = FusedAdam(lr=lr, impl="fused")
+    ddp = DistributedDataParallel(axis_name=DATA_AXIS)
+    vma_kw = {} if has_vma() else {"check_vma": False}
+    pspec = jax.tree_util.tree_map_with_path(
+        lambda path, _: (P(EXPERT_AXIS) if n_ep > 1
+                         and _is_expert_leaf(path) else P()), params0)
+    grad_axes = ((DATA_AXIS, EXPERT_AXIS) if n_ep > 1 else (DATA_AXIS,))
+    expert_axis = EXPERT_AXIS if n_ep > 1 else None
+    tok_spec = (P((DATA_AXIS, EXPERT_AXIS)) if n_ep > 1
+                else P(DATA_AXIS))
+
+    # per-device optimizer state over the LOCAL tree (expert leaves are
+    # 1/n_ep slices): flat m/v/master concatenate over the expert axis
+    e_local = cfg_moe.num_experts // max(n_ep, 1)
+    local_template = jax.tree_util.tree_map_with_path(
+        lambda path, l: (l[:e_local] if n_ep > 1 and _is_expert_leaf(path)
+                         else l), params0)
+    state_shape = jax.eval_shape(opt.init, local_template)
+    sspec = jax.tree_util.tree_map(
+        lambda x: (P(EXPERT_AXIS) if n_ep > 1
+                   and getattr(x, "ndim", 0) >= 1 else P()), state_shape)
+
+    def grads_of(params, tokens):
+        pv = jax.tree_util.tree_map(
+            lambda p: _to_varying(p, grad_axes), params)
+        loss, grads = jax.value_and_grad(lambda p: moe_transformer_loss(
+            p, {"tokens": tokens, "targets": tokens}, cfg_moe,
+            expert_axis=expert_axis))(pv)
+        if n_ep > 1:
+            # dense leaves: psum over expert / n_ep turns the per-shard
+            # loss grads into the expert-axis mean (the sp seq-fold
+            # posture); expert leaves skip the dense fold — their
+            # backward all_to_all already summed every peer's
+            # contribution into the owning shard — and keep only the
+            # 1/n_ep mean scaling
+            grads = jax.tree_util.tree_map_with_path(
+                lambda path, g: (g / n_ep if _is_expert_leaf(path)
+                                 else jax.lax.psum(g, EXPERT_AXIS) / n_ep),
+                grads)
+        return jax.lax.pmean(loss, grad_axes), grads
+
+    def body(params, state, tokens):
+        loss, grads = grads_of(params, tokens)
+        grads = ddp.allreduce_grads(grads)
+        fl = opt.flattener_for(params)
+        flat = fl.flatten(grads)
+        ok = jnp.all(jnp.isfinite(flat)).astype(jnp.float32)
+        new_state = opt.step_flat(state, flat)
+        new_state = jax.tree_util.tree_map(
+            lambda nw, old: jnp.where(ok > 0, nw, old), new_state, state)
+        return fl.unflatten(new_state.master, like=params), new_state, loss
+
+    init_s = jax.jit(shard_map(lambda p: opt.init(p), mesh=mesh,
+                               in_specs=(pspec,), out_specs=sspec,
+                               **vma_kw))
+    step_sm = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(pspec, sspec, tok_spec),
+        out_specs=(pspec, sspec, P()), **vma_kw))
+    state0 = init_s(params0)
+
+    info = {"family": plan.family, "engine": "shard_map.ep",
+            "dp": n_dp, "ep": n_ep, "experts": cfg_moe.num_experts,
+            "capacity_factor": cfg_moe.capacity_factor}
+    if meter:
+        tokens0 = jnp.zeros((global_batch, cfg_moe.max_len), jnp.int32)
+        info["collectives"] = compiled_collectives(
+            step_sm, params0, state0, tokens0)
+        if n_ep > 1:
+            # the python-loop MoE layers keep the router all_to_alls in
+            # the entry computation: meter the compiled payloads
+            # (ep.all_to_all), with the static capacity-factored
+            # schedule carried alongside as the cross-check
+            info["metered"] = meter_compiled_collectives(
+                info["collectives"], "ep", EXPERT_AXIS)
+            info["ep_wire"] = _ep_schedule_bytes(cfg_moe, n_dp, n_ep,
+                                                 global_batch)
 
     def step(carry, tokens):
         params, state = carry

@@ -26,6 +26,14 @@ The classes (each wall-clock second lands in exactly ONE)::
                     timeline decomposition was fed in (without a
                     capture this class honestly reads 0 — unmeasured,
                     not "fully hidden")
+    pipeline_bubble the GPipe fill/drain share of step time under a
+                    pipeline-parallel plan — (S-1)/(M+S-1) of each step
+                    span, carved from ``productive`` the way the
+                    exposed-comm carve rides the measured
+                    decomposition, from the pp engine's STATIC schedule
+                    (``spmd._build_pp_step`` feeds the running ledger
+                    at build time).  A non-pp run never feeds it, so
+                    the class honestly reads 0 — no stages, no bubble
     data_stall      time the step boundary waited on data: the guard's
                     ``data.fetch`` span around each batch fetch plus
                     loader consumer waits (``loader.wait``); producer-
@@ -53,7 +61,8 @@ The classes (each wall-clock second lands in exactly ONE)::
 
 Overlaps resolve by fixed priority (recompile > reshard >
 restore_replay > ckpt_exposed > data_stall > exposed_comm >
-productive), so a compile that fires inside a step span charges
+pipeline_bubble > productive), so a compile that fires inside a step
+span charges
 ``recompile``, not "step time".  The partition is EXACT:
 ``sum(class ms) == wall ms`` up to float rounding, asserted by
 :func:`goodput_violations` (the ``memory.by_class`` proof standard).
@@ -100,7 +109,8 @@ __all__ = [
 #: the wall-clock partition, in ATTRIBUTION PRIORITY order (idle last:
 #: it is defined as wall minus everything classified)
 CLASSES = ("recompile", "reshard", "restore_replay", "ckpt_exposed",
-           "data_stall", "exposed_comm", "productive", "idle")
+           "data_stall", "exposed_comm", "pipeline_bubble", "productive",
+           "idle")
 
 #: every class except productive — what ``goodput.fraction`` excludes
 BADPUT_CLASSES = tuple(c for c in CLASSES if c != "productive")
@@ -234,6 +244,9 @@ class GoodputLedger:
         # from a timeline decomposition (None until a capture exists)
         self._exposed_frac: Optional[Dict[int, float]] = None
         self._exposed_default: Optional[float] = None
+        # the pp engine's static fill/drain fraction ((S-1)/(M+S-1));
+        # 0.0 until a pipeline plan feeds it — non-pp runs stay honest
+        self._bubble_frac: float = 0.0
 
     # -- ingestion (called from the Tracer hook; host floats only) ----------
     def note_span(self, name: str, t_us: float, dur_us: float,
@@ -303,6 +316,17 @@ class GoodputLedger:
         self._exposed_default = float(frac) if isinstance(
             frac, (int, float)) else None
 
+    def set_pipeline_bubble(self, fraction) -> None:
+        """Feed the pp engine's STATIC fill/drain fraction
+        ((S-1)/(M+S-1) — ``spmd._build_pp_step``'s
+        ``pipeline_bubble_fraction``) so that share of every productive
+        step span is carved into the ``pipeline_bubble`` class.  Never
+        called on a non-pp run: the class honestly reads 0 there."""
+        if not self.enabled:
+            return
+        f = float(fraction or 0.0)
+        self._bubble_frac = min(max(f, 0.0), 1.0)
+
     # -- the partition -------------------------------------------------------
     def snapshot(self, *, now_us: Optional[float] = None,
                  status: Optional[str] = None) -> dict:
@@ -335,6 +359,18 @@ class GoodputLedger:
             if carved:
                 merged["exposed_comm"] = _merge(
                     merged["exposed_comm"] + _clip(carved, t0, t1))
+        # the pipeline-bubble carve: the pp engine's static fill/drain
+        # share of each productive step span, taken from the END of the
+        # span (the exposed-comm carve takes the start, so the two
+        # overlap as little as possible; any residual overlap resolves
+        # by the priority subtraction below — the partition stays exact)
+        if self._bubble_frac > 0:
+            f = self._bubble_frac
+            bubbled = [(s1 - f * (s1 - s0), s1)
+                       for (s0, s1, _s) in self._step_spans]
+            if bubbled:
+                merged["pipeline_bubble"] = _merge(
+                    merged["pipeline_bubble"] + _clip(bubbled, t0, t1))
         # priority subtraction: class k keeps what no higher class claims
         claimed: List[Tuple[float, float]] = []
         parts: Dict[str, float] = {}

@@ -109,6 +109,11 @@ SCHEMA = {
     "plan_tp": _is_block,
     "plan_sp": _is_block,
     "plan_sp_strategy": lambda v: v in ("none", "ring", "ulysses"),
+    # pipeline (GPipe stages x microbatches) + expert-parallel width —
+    # 1 = family off, same posture as plan_tp/plan_sp
+    "plan_pp_stages": _is_block,
+    "plan_pp_microbatches": _is_block,
+    "plan_ep": _is_block,
     "plan_zero": _is_bool,
     "plan_update_sharding": lambda v: v in ("off", "zero1"),
     "plan_collective_scheme": lambda v: v in ("fp32", "bf16",

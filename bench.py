@@ -926,9 +926,10 @@ def bench_plan(on_tpu, top_k=3, steps=5):
     count, then MEASURE the top-k predicted plans (plus the all-defaults
     baseline) through the real step each plan's ``apply()`` configures —
     since the ``parallel.spmd`` engine every family is runnable, so the
-    measured set is topped up with the best-ranked tp and sp candidates
-    when the top-k misses them (the acceptance surface: >= 1 tp>1 and
-    >= 1 sp>1 plan measured alongside the dp family).  The RANKING uses
+    measured set is topped up with the best-ranked tp/sp/pp/ep
+    candidates when the top-k misses them (the acceptance surface:
+    every model-parallel family measured alongside dp — two rows per
+    family where the space allows).  The RANKING uses
     the production enumeration (``SP_MIN_SEQ`` floor and all) — when
     the profile's sequence is too short for any production sp plan (the
     CPU stand-in's seq 64), sp representatives are enumerated
@@ -985,9 +986,20 @@ def bench_plan(on_tpu, top_k=3, steps=5):
                    if p.family == "sp" and p.feasible]
         sp_pool.sort(key=lambda p: p.predicted_step_ms)
         pool += sp_pool
-    for fam in ("tp", "sp"):
+    for fam in ("tp", "sp", "pp", "ep"):
         have = sum(p.family == fam for p in cand)
-        for rep in (p for p in pool if p.family == fam):
+        reps = [p for p in pool if p.family == fam]
+        if fam in ("pp", "ep"):
+            # coverage rows stay on the fp32 wire: a compressed-scheme
+            # twin measures the codec's cast cost (large on CPU)
+            # against an fp32 family anchor — drift that says nothing
+            # about the pp/ep engine — while a second STRUCTURAL point
+            # (a different microbatch or expert split) is what the
+            # family calibration is for.  The space always has one
+            # (>= 2 microbatch options / >= 2 expert widths).
+            fp32 = [p for p in reps if p.collective_scheme == "fp32"]
+            reps = fp32 or reps
+        for rep in reps:
             if have >= 2:
                 break
             if not any(rep.knobs() == c.knobs() for c in cand):
@@ -1110,7 +1122,8 @@ def bench_plan(on_tpu, top_k=3, steps=5):
 def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
     """SPMD step-engine A/B (ISSUE 12, watcher stage 2e): one
     representative plan per engine family — dp x tp (GSPMD), dp x sp
-    ring, dp x sp ulysses, zero1 update sharding, contrib ZeRO —
+    ring, dp x sp ulysses, zero1 update sharding, contrib ZeRO, dp x pp
+    (GPipe stages), dp x ep (switch-MoE, vs its dp-MoE twin) —
     trained a few steps against the dp baseline on the same batch.
     Evidence per family: step ms, final-loss relative error vs the
     baseline (the engines are fp32-tolerance-equivalent by
@@ -1144,6 +1157,16 @@ def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
         plans.append(("zero1", planmod.Plan(dp=n_dev,
                                             update_sharding="zero1")))
         plans.append(("zero", planmod.Plan(dp=n_dev, zero=True)))
+        if cfg.num_layers % 2 == 0 and (gb // (n_dev // 2)) % 2 == 0:
+            plans.append(("dp_pp", planmod.Plan(
+                dp=n_dev // 2, pp_stages=2, pp_microbatches=2)))
+        if gb % n_dev == 0:
+            # the ep pair: its loss is the MoE objective (mlm + aux),
+            # so parity is measured against a dp-MoE baseline — the
+            # SAME ep engine on a data-only mesh (full expert set per
+            # device, no exchange), not the dense dp baseline
+            plans.append(("dp_moe_baseline", planmod.Plan(dp=n_dev)))
+            plans.append(("dp_ep", planmod.Plan(dp=n_dev // 2, ep=2)))
 
     sink = telemetry.MemorySink()
     reg = telemetry.Registry(sink=sink, flush_interval=0,
@@ -1153,6 +1176,7 @@ def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
     out = {"leg": "spmd", "chips": n_dev, "global_batch": gb,
            "families": {}}
     base_loss = None
+    moe_base_loss = None
     # opt-in one-step profiled capture (the overlap measurement; the
     # watcher's stage 2e sets this so stage 2f can decompose it)
     profile_dir = os.environ.get("APEX_BENCH_PROFILE_DIR")
@@ -1162,8 +1186,13 @@ def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
         for name, p in plans:
             _log(f"spmd leg: {name} [{p.describe() or 'all-defaults'}] ...")
             with p.apply() as mesh:
-                carry, step, info = spmdmod.build_plan_step(
-                    cfg, mesh, p, global_batch=gb)
+                if name == "dp_moe_baseline":
+                    # force the ep engine at ep=1: the dp-MoE oracle
+                    carry, step, info = spmdmod._build_ep_step(
+                        cfg, mesh, p, gb, 1e-2, True)
+                else:
+                    carry, step, info = spmdmod.build_plan_step(
+                        cfg, mesh, p, global_batch=gb)
                 t0 = time.perf_counter()
                 carry, loss = step(carry, tokens)
                 _sync(loss)
@@ -1189,15 +1218,22 @@ def bench_spmd(on_tpu, steps=4, cfg=None, global_batch=None):
             loss = float(loss)
             if name == "dp_baseline":
                 base_loss = loss
+            if name == "dp_moe_baseline":
+                moe_base_loss = loss
             h.observe(ms)
             rec = {"plan": p.describe() or "all-defaults",
                    "family": p.family, "engine": info.get("engine"),
                    "step_ms": round(ms, 3),
                    "compile_ms": round(compile_ms, 1),
                    "loss": loss}
-            if base_loss:
+            # ep legs train the MoE objective: their parity oracle is
+            # the dp-MoE baseline, not the dense one
+            ref_loss = (moe_base_loss
+                        if info.get("engine") == "shard_map.ep"
+                        else base_loss)
+            if ref_loss:
                 rec["loss_rel_err_vs_baseline"] = round(
-                    abs(loss - base_loss) / abs(base_loss), 6)
+                    abs(loss - ref_loss) / abs(ref_loss), 6)
             if info.get("collectives"):
                 rec["collectives"] = info["collectives"]
             out["families"][name] = rec
@@ -1324,6 +1360,139 @@ def bench_overlap(on_tpu, steps=6, cfg=None, global_batch=None):
         out["logical_bytes_equal"] = (
             buck["allreduce_logical_bytes"]
             == off["allreduce_logical_bytes"])
+    reg.flush()
+    out["telemetry"] = {"records": sink.records,
+                        "summary": treport.summarize(sink.records)}
+    return out
+
+
+def bench_ppep(on_tpu, steps=6, cfg=None, global_batch=None):
+    """Pipeline + expert engine A/B (PR 17, watcher stage 2h): each new
+    family trained ``steps`` steps against ITS parity oracle on the
+    same batch — pp (GPipe stages over ``ppermute``) vs the dense dp
+    baseline, ep (capacity-factored switch-MoE over ``all_to_all``) vs
+    the dp-MoE twin (the SAME ep engine on a data-only mesh: full
+    expert set per device, no exchange — the identical per-token
+    function).  Evidence per family: the per-step loss trajectories
+    with a ``parity_ok`` verdict at the repo's fp32-tolerance bar, step
+    ms both legs, and the wire story — pp's static ``ppermute``
+    schedule (fill-drain ticks x per-tick block) + bubble fraction, and
+    ep's compiled-HLO ``all-to-all`` sub-table cross-checked against
+    the static capacity-factored schedule."""
+    import numpy as np
+    from apex_tpu import telemetry
+    from apex_tpu.parallel import plan as planmod
+    from apex_tpu.parallel import spmd as spmdmod
+    from apex_tpu.telemetry import events as tel_events
+    from apex_tpu.telemetry import report as treport
+
+    n_dev = len(jax.devices())
+    if cfg is None:
+        cfg = planmod._flagship_cfg(on_tpu)
+    gb = global_batch or (32 if on_tpu else 8)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (gb, cfg.max_len)).astype("int32"))
+
+    sink = telemetry.MemorySink()
+    reg = telemetry.Registry(sink=sink, flush_interval=0,
+                             rank0_only=False, run_id="bench",
+                             memory=False)
+    h = reg.histogram("step_time_ms")
+    out = {"leg": "ppep", "chips": n_dev, "global_batch": gb,
+           "steps": steps, "families": {}}
+
+    def _run_leg(p, forced_ep=False):
+        """Both legs of a pair run IDENTICALLY (first step = compile,
+        the rest timed) from the same PRNGKey(0) init on the same
+        batch, so the per-step losses line up index-for-index."""
+        with p.apply() as mesh:
+            if forced_ep:
+                carry, step, info = spmdmod._build_ep_step(
+                    cfg, mesh, p, gb, 1e-2, True)
+            else:
+                carry, step, info = spmdmod.build_plan_step(
+                    cfg, mesh, p, global_batch=gb)
+            losses = []
+            t0 = time.perf_counter()
+            carry, loss = step(carry, tokens)
+            _sync(loss)
+            compile_ms = (time.perf_counter() - t0) * 1e3
+            losses.append(float(loss))
+            t0 = time.perf_counter()
+            for _ in range(steps - 1):
+                carry, loss = step(carry, tokens)
+                losses.append(float(loss))
+            _sync(loss)
+            ms = (time.perf_counter() - t0) / max(steps - 1, 1) * 1e3
+        del carry, step
+        gc.collect()
+        return losses, ms, compile_ms, info
+
+    def _tol(ref):
+        # the repo's fp32-tolerance bar (tests/L0/test_spmd.py): the
+        # engines change only collective placement/reduction order
+        return max(2e-2 * abs(ref), 5e-3)
+
+    pairs = []
+    if n_dev % 2 == 0 and cfg.num_layers % 2 == 0 \
+            and (gb // (n_dev // 2)) % 2 == 0:
+        pairs.append(("pp", planmod.Plan(dp=n_dev), False,
+                      planmod.Plan(dp=n_dev // 2, pp_stages=2,
+                                   pp_microbatches=2), False))
+    if n_dev % 2 == 0 and gb % n_dev == 0:
+        pairs.append(("ep", planmod.Plan(dp=n_dev), True,
+                      planmod.Plan(dp=n_dev // 2, ep=2), False))
+
+    prev = tel_events.set_default(reg)
+    try:
+        for fam, base_p, base_forced, cand_p, cand_forced in pairs:
+            _log(f"ppep leg: {fam} baseline "
+                 f"[{base_p.describe() or 'all-defaults'}] ...")
+            b_losses, b_ms, b_compile, _ = _run_leg(base_p, base_forced)
+            _log(f"ppep leg: {fam} candidate [{cand_p.describe()}] ...")
+            c_losses, c_ms, c_compile, info = _run_leg(cand_p, cand_forced)
+            h.observe(c_ms)
+            rec = {
+                "baseline": {"plan": base_p.describe() or "all-defaults",
+                             "step_ms": round(b_ms, 3),
+                             "compile_ms": round(b_compile, 1),
+                             "losses": b_losses},
+                "candidate": {"plan": cand_p.describe(),
+                              "engine": info.get("engine"),
+                              "step_ms": round(c_ms, 3),
+                              "compile_ms": round(c_compile, 1),
+                              "losses": c_losses},
+                "loss_rel_err_final": round(
+                    abs(c_losses[-1] - b_losses[-1])
+                    / max(abs(b_losses[-1]), 1e-9), 6),
+                "parity_ok": all(abs(a - b) <= _tol(b)
+                                 for a, b in zip(c_losses, b_losses)),
+                "speedup_vs_baseline": round(b_ms / c_ms, 3) if c_ms
+                else None,
+            }
+            if fam == "pp":
+                rec["pp_wire"] = info.get("pp_wire")
+                rec["pipeline_bubble_fraction"] = info.get(
+                    "pipeline_bubble_fraction")
+            if fam == "ep":
+                rec["metered"] = info.get("metered")
+                rec["ep_wire"] = info.get("ep_wire")
+                a2a = (info.get("metered") or {}).get("all-to-all")
+                wire = info.get("ep_wire") or {}
+                # one fwd + one bwd exchange per static-schedule byte:
+                # compiled logical must equal the static schedule
+                rec["wire_matches_schedule"] = bool(
+                    a2a and int(a2a["logical_bytes"])
+                    == int(wire.get("logical_bytes", -1)))
+            reg.gauge(f"ppep.{fam}.step_ms").set(c_ms)
+            reg.gauge(f"ppep.{fam}.baseline_step_ms").set(b_ms)
+            out["families"][fam] = rec
+    finally:
+        tel_events.set_default(prev)
+    out["parity_ok"] = all(r.get("parity_ok")
+                           for r in out["families"].values()) \
+        and bool(out["families"])
     reg.flush()
     out["telemetry"] = {"records": sink.records,
                         "summary": treport.summarize(sink.records)}
@@ -1584,6 +1753,18 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
     else:
         _log("skipping spmd leg (budget)")
     gc.collect()
+    # pipeline/expert engine A/B (PR 17): pp vs the dense dp baseline +
+    # ep vs its dp-MoE twin, loss parity + wire evidence per family
+    if budget_left() > 60:
+        try:
+            with _leg_span("ppep"):
+                detail["ppep"] = bench_ppep(on_tpu)
+        except Exception as err:
+            detail["ppep"] = {"error": repr(err)[:200]}
+        flush("ppep", detail["ppep"])
+    else:
+        _log("skipping ppep leg (budget)")
+    gc.collect()
     # async-overlap A/B (PR 16): deferred vs bucketed flagship step —
     # loss parity + per-leg exposed-comm capture feeding the
     # ddp_overlap / overlap_fraction_<scheme> decisions
@@ -1840,6 +2021,19 @@ def _spmd_main():
                       "spmd": bench_spmd(on_tpu)}))
 
 
+def _ppep_main():
+    """``python bench.py --ppep``: ONLY the pipeline/expert engine A/B
+    on the ambient backend, one JSON line — the leg tpu_watch.sh runs
+    as its own stage 2h (a two-pair A/B fits a short tunnel window the
+    full bench would waste)."""
+    from apex_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+    on_tpu = jax.default_backend() == "tpu"
+    print(json.dumps({"metric": "ppep_ab",
+                      "backend": jax.default_backend(),
+                      "ppep": bench_ppep(on_tpu)}))
+
+
 if __name__ == "__main__":
     if "--collectives" in sys.argv:
         _collectives_main()
@@ -1853,6 +2047,8 @@ if __name__ == "__main__":
         _goodput_main()
     elif "--overlap" in sys.argv:
         _overlap_main()
+    elif "--ppep" in sys.argv:
+        _ppep_main()
     elif "--inner" in sys.argv:
         _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:

@@ -66,8 +66,18 @@ def parse_args(argv=None):
                    help="ZeRO sharded optimizer (DistributedFusedLAMB)")
     p.add_argument("--moe", type=int, default=0, metavar="E",
                    help="use a Mixture-of-Experts FFN with E experts "
-                        "(single-device MoE here; sharded ep lives in "
-                        "tests/dryrun via shard_map)")
+                        "(single-device MoE here; for SHARDED expert "
+                        "parallelism use --plan, which materializes the "
+                        "ep engine)")
+    p.add_argument("--plan", action="store_true",
+                   help="planner-driven parallelism: resolve the "
+                        "parallel plan from the measured tuning profile "
+                        "(plan.from_tuning) when one matches the ambient "
+                        "topology, else cost-model search (plan.search) "
+                        "over this config's own profiled step, then run "
+                        "the winner through spmd.build_plan_step — "
+                        "dp/tp/sp/pp/ep as measured engine families "
+                        "instead of hand-wired sharding flags")
     p.add_argument("--attn", default="default",
                    choices=("default", "fast"),
                    help="attention impl: 'fast' = the contrib flash "
@@ -239,10 +249,65 @@ def run_zero(args, cfg, mesh):
     return holder, step
 
 
+def run_plan(args, cfg):
+    """Planner-driven parallelism (``--plan``): the measured tuning
+    winner (``plan.from_tuning`` — the bench ``plan`` leg's persisted
+    ``plan_*`` keys) when one matches the ambient chip count, else the
+    cost-model search (``plan.search``) over a profile of THIS config's
+    train step; the chosen plan is materialized through
+    ``spmd.build_plan_step``.  This replaces hand-wired sharding flags
+    for the model-parallel families: tp, sp, pipeline (GPipe stages x
+    microbatches) and expert parallelism all arrive as plannable,
+    measurable engines — an ep winner builds the sharded switch-MoE
+    step the old single-device ``--moe`` wiring could not."""
+    from apex_tpu.parallel import plan as planmod
+    from apex_tpu.parallel import spmd as spmdmod
+
+    n_dev = len(jax.devices())
+    chosen = planmod.from_tuning(n_dev)
+    source = "tuned_defaults.json"
+    if chosen is None:
+        prof, _, _ = planmod.flagship_profile(
+            cfg=cfg, global_batch=args.batch_size)
+        ranked = planmod.search(prof, n_dev)
+        if not ranked:
+            raise SystemExit(f"--plan: no feasible plan at {n_dev} chips "
+                             f"for batch {args.batch_size}")
+        chosen = ranked[0]
+        source = f"cost-model search ({len(ranked)} feasible)"
+    print(f"=> plan [{source}]: {chosen.describe()}")
+
+    rng = np.random.RandomState(args.seed)
+    losses, tput = AverageMeter("mlm_loss"), Throughput()
+    with chosen.apply(jax.devices()[: chosen.chips]) as mesh:
+        carry, step, info = spmdmod.build_plan_step(
+            cfg, mesh, chosen, global_batch=args.batch_size, lr=args.lr,
+            meter=False)
+        print(f"=> engine {info.get('engine')} (family "
+              f"{info.get('family')}) on {chosen.chips} device(s)")
+        for i in range(args.steps):
+            tokens = rng.randint(0, cfg.vocab_size,
+                                 size=(args.batch_size, cfg.max_len)
+                                 ).astype(np.int32)
+            carry, loss = step(carry, jnp.asarray(tokens))
+            if (i + 1) % args.print_freq == 0 or i == args.steps - 1:
+                losses.update(float(loss))
+                rate = tput.tick(args.print_freq * args.batch_size)
+                print(f"step {i + 1:4d}  {losses}  "
+                      f"{rate:.1f} sequences/sec", flush=True)
+    print(f"=> done: final loss {losses.val:.4f}")
+    return losses.val
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.moe and (args.bert_large or args.zero):
         raise SystemExit("--moe combines with the standard path only")
+    if args.plan and (args.moe or args.zero or args.distributed
+                      or args.auto_resume):
+        raise SystemExit("--plan owns the parallelism decision — it does "
+                         "not combine with --moe/--zero/--distributed/"
+                         "--auto-resume")
     if args.bert_large:
         cfg = bert_large_config(dtype=jnp.bfloat16, remat=args.remat,
                                 attn_impl=args.attn)
@@ -259,6 +324,8 @@ def main(argv=None):
             num_layers=args.layers, d_model=args.d_model,
             num_heads=args.heads, d_ff=4 * args.d_model,
             dtype=jnp.bfloat16, remat=args.remat, attn_impl=args.attn)
+    if args.plan:
+        return run_plan(args, cfg)
     n_dev = len(jax.devices()) if (args.distributed or args.zero) else 1
     if args.batch_size % n_dev:
         raise ValueError(f"batch {args.batch_size} must divide {n_dev}")

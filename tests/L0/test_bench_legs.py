@@ -396,6 +396,11 @@ def _stub_timings(bench, monkeypatch, wedge_at=None):
                             "logical_bytes_equal": True,
                             "modes": {"off": {"step_ms": 2.0},
                                       "bucketed": {"step_ms": 1.8}}}))
+    monkeypatch.setattr(bench, "bench_ppep",
+                        mk("bench_ppep",
+                           {"leg": "ppep", "parity_ok": True,
+                            "families": {"pp": {"parity_ok": True},
+                                         "ep": {"parity_ok": True}}}))
     monkeypatch.setattr(bench, "bench_plan",
                         mk("bench_plan",
                            {"leg": "plan", "chips": 8,
@@ -444,7 +449,8 @@ def test_run_bench_full_flush_sequence(tmp_path, monkeypatch):
                 else "rn50_cpu_standin_resnet18")
     assert set(legs) == {"headline", rn50_key, "bert_e2e", "collectives",
                          "update_sharding", "plan", "spmd", "overlap",
-                         "goodput"}
+                         "ppep", "goodput"}
+    assert legs["ppep"]["data"]["leg"] == "ppep"
     assert legs["collectives"]["data"]["leg"] == "collectives"
     assert legs["goodput"]["data"]["leg"] == "goodput"
     assert legs["overlap"]["data"]["leg"] == "overlap"

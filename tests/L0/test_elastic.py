@@ -157,6 +157,94 @@ def test_layout_meta_contents():
 
 
 # ---------------------------------------------------------------------------
+# stage/expert lattice resharding (ISSUE 17: pp/ep resize@N:M)
+# ---------------------------------------------------------------------------
+
+def _lattice_layout(length, rows, lane=LANE):
+    """Contiguous-fill row lattice for a canonical flat sequence of
+    ``length`` elements: row_total rounded up to whole lanes, full rows
+    then one partial tail row — padding only at the global tail (the
+    layout the elastic stacked rule reproduces)."""
+    per = -(-length // rows)
+    row_total = -(-per // lane) * lane
+    row_used = [max(min(length - i * row_total, row_total), 0)
+                for i in range(rows)]
+    return row_total, row_used
+
+
+def _pack_lattice(flat, rows):
+    """(lattice, stacked-block) — the contiguous fill IS the zero-padded
+    flat reshaped row-major, so pack/unpack are shape games only."""
+    flat = np.asarray(flat)
+    row_total, row_used = _lattice_layout(flat.shape[0], rows)
+    lat = np.zeros((rows * row_total,), flat.dtype)
+    lat[:flat.shape[0]] = flat
+    return lat.reshape(rows, row_total), {
+        "rows": rows, "row_total": row_total, "row_used": row_used}
+
+
+def _stacked_meta(world, length, block):
+    return {"world_size": world,
+            "layout": {"flat_total": block["rows"] * block["row_total"],
+                       "used": length, "stacked": dict(block)}}
+
+
+@pytest.mark.parametrize("n,m", [(2, 4), (4, 2), (2, 3), (3, 2), (8, 3)])
+def test_stacked_lattice_reshard_roundtrip_bitwise(n, m):
+    """Property: per-stage/per-expert flat lattices re-slice N -> M -> N
+    BITWISE through the canonical-flat path, including non-divisible
+    row counts (real padding on both sides of the trip)."""
+    rng = np.random.RandomState(7)
+    flat = rng.randn(1000).astype(np.float32)   # 1000: no lane alignment
+    lat_n, blk_n = _pack_lattice(flat, n)
+    lat_m_ref, blk_m = _pack_lattice(flat, m)
+
+    tmpl_m = {"lat": jnp.zeros(lat_m_ref.shape, jnp.float32)}
+    out = elastic.reshard_payload(
+        tmpl_m, {"step": 1, "leaves": [lat_n]},
+        _stacked_meta(n, flat.shape[0], blk_n), m)
+    got = np.asarray(out["leaves"][0])
+    np.testing.assert_array_equal(got, lat_m_ref)
+
+    tmpl_n = {"lat": jnp.zeros(lat_n.shape, jnp.float32)}
+    back = elastic.reshard_payload(
+        tmpl_n, {"step": 1, "leaves": [got]},
+        _stacked_meta(m, flat.shape[0], blk_m), n)
+    np.testing.assert_array_equal(np.asarray(back["leaves"][0]), lat_n)
+
+
+def test_stacked_lattice_int_row_used_and_typed_errors():
+    """The scalar ``row_used`` broadcast (every row full), and the
+    typed failure modes: a live lattice too small for the content is a
+    model change, a nonzero tail beyond ``row_used`` is refused rather
+    than silently dropped, and a ``row_used`` arity mismatch names the
+    counts."""
+    flat = np.arange(1, 513, dtype=np.float32)        # 512 = 4 lanes
+    lat, blk = _pack_lattice(flat, 4)
+    assert blk["row_used"] == [128] * 4
+    meta = _stacked_meta(4, 512, blk)
+    meta["layout"]["stacked"]["row_used"] = 128       # int broadcast
+    tmpl = {"lat": jnp.zeros((2, 256), jnp.float32)}
+    out = elastic.reshard_payload(tmpl, {"step": 0, "leaves": [lat]},
+                                  meta, 2)
+    np.testing.assert_array_equal(np.asarray(out["leaves"][0]).ravel(),
+                                  flat)
+
+    small = {"lat": jnp.zeros((2, 128), jnp.float32)}
+    with pytest.raises(WorldSizeMismatchError, match="resize"):
+        elastic.reshard_payload(small, {"step": 0, "leaves": [lat]},
+                                meta, 2)
+    dirty = _stacked_meta(4, 484, dict(blk, row_used=[100, 128, 128, 128]))
+    with pytest.raises(WorldSizeMismatchError, match="resize"):
+        elastic.reshard_payload(tmpl, {"step": 0, "leaves": [lat]},
+                                dirty, 2)
+    bad = _stacked_meta(4, 512, dict(blk, row_used=[128, 128]))
+    with pytest.raises(WorldSizeMismatchError, match="row_used"):
+        elastic.reshard_payload(tmpl, {"step": 0, "leaves": [lat]},
+                                bad, 2)
+
+
+# ---------------------------------------------------------------------------
 # manifest meta (satellite: ckpt.py)
 # ---------------------------------------------------------------------------
 
@@ -445,6 +533,132 @@ def test_grow_4_to_8_fp32_tolerance(harnesses, tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(dd),
                                    rtol=0.25, atol=2e-2,
                                    err_msg=str(kp))
+
+
+def _moe_lattice_harness(rows):
+    """An ep-flagship training step whose per-expert FFN weights live
+    in a ``(rows, row_total)`` stacked flat lattice — the storage
+    layout an expert-sharded run checkpoints, and exactly what the
+    elastic stacked rule reshards across widths.  The step itself is
+    world-independent (unpack -> same params -> same SGD update), so a
+    resized resume must continue BITWISE."""
+    from apex_tpu.models.moe_transformer import (MoETransformerConfig,
+                                                 moe_transformer_init,
+                                                 moe_transformer_loss)
+    cfg = MoETransformerConfig(vocab_size=64, max_len=8, num_layers=1,
+                               d_model=16, num_heads=2, d_ff=32,
+                               num_experts=8)
+    full0 = moe_transformer_init(jax.random.PRNGKey(0), cfg)
+    shapes = [(l["w_in"].shape, l["w_out"].shape)
+              for l in full0["layers"]]
+    canon = sum(int(np.prod(si)) + int(np.prod(so))
+                for si, so in shapes)
+    row_total, row_used = _lattice_layout(canon, rows)
+
+    def split(full):
+        pieces, layers = [], []
+        for l in full["layers"]:
+            l = dict(l)
+            pieces.append(l.pop("w_in").ravel())
+            pieces.append(l.pop("w_out").ravel())
+            layers.append(l)
+        flat = jnp.concatenate(pieces)
+        lat = jnp.zeros((rows * row_total,), flat.dtype)
+        return ({**full, "layers": layers},
+                lat.at[:canon].set(flat).reshape(rows, row_total))
+
+    def join(dense, lat):
+        flat = lat.reshape(-1)[:canon]
+        off, layers = 0, []
+        for l, (si, so) in zip(dense["layers"], shapes):
+            ni, no = int(np.prod(si)), int(np.prod(so))
+            layers.append({**l,
+                           "w_in": flat[off:off + ni].reshape(si),
+                           "w_out": flat[off + ni:off + ni + no]
+                           .reshape(so)})
+            off += ni + no
+        return {**dense, "layers": layers}
+
+    lr = 0.05
+
+    @jax.jit
+    def jstep(dense, lat, tokens):
+        def loss_fn(dn, lt):
+            return moe_transformer_loss(
+                join(dn, lt), {"tokens": tokens, "targets": tokens}, cfg)
+        loss, (gd, gl) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(dense, lat)
+        dense = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                       dense, gd)
+        # the lattice padding gets exact-zero grads (the loss reads
+        # only the used prefix), so it stays zero — re-resizable
+        return dense, lat - lr * gl, loss
+
+    def step_fn(state, batch):
+        dense, lat = state
+        dense, lat, loss = jstep(dense, lat, batch)
+        return (dense, lat), loss
+
+    layout = {"flat_total": rows * row_total, "used": canon,
+              "stacked": {"rows": rows, "row_total": row_total,
+                          "row_used": row_used}}
+    return split(full0), step_fn, layout
+
+
+def _moe_batch(step):
+    rng = np.random.RandomState(500 + step)
+    return jnp.asarray(rng.randint(0, 64, (4, 8)).astype("int32"))
+
+
+def test_chaos_resize_ep_lattice_2_to_3_bitwise(tmp_path):
+    """ACCEPTANCE (ISSUE 17): resize@4:3 kills a 2-shard ep-flagship
+    run mid-epoch; the 3-shard resume reshards the expert lattice
+    through elastic (non-divisible 2 -> 3, real tail padding) and
+    finishes BITWISE-identical to a clean 3-shard run started from the
+    same checkpoint via an independent numpy import."""
+    state2, step2, layout2 = _moe_lattice_harness(2)
+    state3, step3, layout3 = _moe_lattice_harness(3)
+    assert layout2["stacked"]["row_total"] * 3 != layout3["flat_total"]
+    d = tmp_path / "ep"
+
+    plan = faults.parse("resize@4:3")
+    _, r1 = TrainGuard(step2, _gcfg(d, 2, layout2), plan=plan).run(
+        state2, _moe_batch, 8)
+    assert r1.status == "preempted" and r1.final_step == 4
+    assert r1.resize_to == 3 and r1.faults_injected == 1
+
+    # the independent comparator: numpy re-slice of the lattice leaf
+    # (no elastic code), then the remaining steps plain 3-shard
+    ck_step, payload, meta = CheckpointManager(str(d)).load_latest(
+        with_meta=True)
+    assert ck_step == 4 and meta["world_size"] == 2
+    _, treedef2 = jax.tree_util.tree_flatten(state2)
+    dense_s, lat_s = jax.tree_util.tree_unflatten(treedef2,
+                                                  payload["leaves"])
+    blk = meta["layout"]["stacked"]
+    flat = np.concatenate([np.asarray(lat_s)[i, :u]
+                           for i, u in enumerate(blk["row_used"]) if u])
+    lat3_ref, _ = _pack_lattice(flat, 3)
+    state_b = (jax.tree_util.tree_map(jnp.asarray, dense_s),
+               jnp.asarray(lat3_ref))
+    for i in range(ck_step, 8):
+        state_b, _ = step3(state_b, _moe_batch(i))
+
+    er = elastic.ElasticResume()
+    state_a, r2 = TrainGuard(step3, _gcfg(d, 3, layout3), plan=plan,
+                             elastic=er).run(state3, _moe_batch, 8)
+    assert r2.status == "completed" and r2.final_step == 8
+    assert r2.resumed_from == 4 and r2.resharded_from == 2
+
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(state_a),
+            jax.tree_util.tree_leaves_with_path(state_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(kp))
+    # the resized lattice kept its padding exactly zero
+    lat_a = np.asarray(state_a[1])
+    assert lat_a.shape == (3, layout3["stacked"]["row_total"])
+    assert not np.any(lat_a.reshape(-1)[layout3["used"]:])
 
 
 def test_old_manifest_degrades_with_typed_warning(harnesses, tmp_path):

@@ -184,6 +184,57 @@ def test_decomposition_carves_measured_exposed_comm():
     assert led2.snapshot()["classes"]["exposed_comm"]["ms"] == 0.0
 
 
+def test_pipeline_bubble_carve_oracle():
+    """The pp engine's static fill/drain fraction carves
+    ``pipeline_bubble`` out of each productive step span (from the END
+    of the span — exposed comm carves the start), and the partition
+    stays exact."""
+    led = goodput.GoodputLedger()
+    t0 = led.t0_us
+    for s in range(3):
+        led.note_span("train.step", t0 + (10 + s * 20) * MS, 10 * MS,
+                      step=s)
+    led.set_pipeline_bubble(1.0 / 3.0)    # S=2, M=2: (S-1)/(M+S-1)
+    doc = led.snapshot(now_us=t0 + 80 * MS)
+    assert doc["classes"]["pipeline_bubble"]["ms"] == pytest.approx(10.0)
+    assert doc["classes"]["productive"]["ms"] == pytest.approx(20.0)
+    _partition_exact(doc)
+    assert goodput.goodput_violations(doc) == []
+
+
+def test_pipeline_bubble_zero_for_non_pp():
+    """No pp plan ever feeds the ledger -> the class honestly reads 0
+    (not "no bubble measured" ambiguity)."""
+    led = goodput.GoodputLedger()
+    led.note_span("train.step", led.t0_us + MS, 10 * MS, step=0)
+    doc = led.snapshot(now_us=led.t0_us + 20 * MS)
+    assert doc["classes"]["pipeline_bubble"]["ms"] == 0.0
+    assert doc["classes"]["productive"]["ms"] == pytest.approx(10.0)
+    assert goodput.goodput_violations(doc) == []
+    # a disabled ledger's setter is a no-op
+    led2 = goodput.GoodputLedger(enabled=False)
+    led2.set_pipeline_bubble(0.5)
+    assert led2._bubble_frac == 0.0
+
+
+def test_pipeline_bubble_composes_with_exposed_comm():
+    """Both carves on the same step span: exposed takes the start,
+    bubble takes the end, productive keeps the middle — and the three
+    still partition the span exactly (priority subtraction)."""
+    led = goodput.GoodputLedger()
+    t0 = led.t0_us
+    led.note_span("train.step", t0 + 10 * MS, 10 * MS, step=0)
+    led.set_decomposition({"totals": {"exposed_comm_fraction": 0.2},
+                           "steps": []})
+    led.set_pipeline_bubble(0.3)
+    doc = led.snapshot(now_us=t0 + 30 * MS)
+    assert doc["classes"]["exposed_comm"]["ms"] == pytest.approx(2.0)
+    assert doc["classes"]["pipeline_bubble"]["ms"] == pytest.approx(3.0)
+    assert doc["classes"]["productive"]["ms"] == pytest.approx(5.0)
+    _partition_exact(doc)
+    assert goodput.goodput_violations(doc) == []
+
+
 def test_interval_cap_drops_visibly():
     led = goodput.GoodputLedger(max_intervals=3)
     t0 = led.t0_us
@@ -211,6 +262,11 @@ def test_fault_badput_mapping_complete():
         assert cls in valid, (kind, cls)
     # a fault can never be declared "productive"
     assert "productive" not in set(goodput.FAULT_BADPUT.values())
+    # the pp engine's schedule class is a declared badput class (it is
+    # carved from the static schedule, never from a fault injection —
+    # no fault kind may claim it)
+    assert "pipeline_bubble" in goodput.BADPUT_CLASSES
+    assert "pipeline_bubble" not in set(goodput.FAULT_BADPUT.values())
 
 
 # ---------------------------------------------------------------------------

@@ -191,6 +191,80 @@ def test_sp_engine_meters_static_schedule():
     assert vals["sp.ppermute_bytes"] == info_r["sp_wire"]["logical_bytes"]
 
 
+def test_dp4_pp2_trains_to_fp32_tolerance_with_metered_ppermute(baseline6):
+    """ACCEPTANCE (ISSUE 17): the GPipe engine (dp=4 x pp=2, M=2)
+    trains the flagship 6 steps to fp32-tolerance vs the dp=8
+    baseline, with the ``pp.ppermute`` wire metered from the engine's
+    exact static schedule (the fori_loop hides the hops from the
+    compiled-HLO entry walk, like the sp ring)."""
+    from apex_tpu import telemetry
+    from apex_tpu.telemetry import events as tel_events
+    sink = telemetry.MemorySink()
+    reg = telemetry.Registry(sink=sink, flush_interval=0,
+                             rank0_only=False, run_id="t", memory=False)
+    prev = tel_events.set_default(reg)
+    try:
+        losses, _, info = _run(
+            pm.Plan(dp=4, pp_stages=2, pp_microbatches=2), meter=True)
+    finally:
+        tel_events.set_default(prev)
+    _assert_fp32_tolerance(losses, baseline6)
+    assert info["engine"] == "shard_map.pp"
+    assert info["stages_layers"] == CFG.num_layers // 2
+    assert info["pipeline_bubble_fraction"] == pytest.approx(1 / 3)
+    # the static schedule: (M + S - 1) ticks, each hopping one
+    # microbatch activation block, and the backward mirrors every hop
+    esize = jnp.dtype(CFG.dtype).itemsize
+    blk = (GB // 4 // 2) * CFG.max_len * CFG.d_model * esize
+    sched = info["pp_wire"]
+    assert sched["op"] == "ppermute"
+    assert sched["ticks"] == 2 + 2 - 1
+    assert sched["per_tick_block_bytes"] == blk
+    assert sched["logical_bytes"] == 2 * 3 * blk
+    vals = reg.read()
+    assert vals["pp.ppermute_bytes"] == sched["logical_bytes"]
+
+
+def test_dp4_ep2_loss_parity_vs_dp_moe_twin_with_metered_a2a():
+    """ACCEPTANCE (ISSUE 17): the switch-MoE engine (dp=4 x ep=2)
+    holds per-step loss parity vs the dp-MoE twin — the SAME engine on
+    a data-only mesh (full expert set per device, no exchange), the
+    identical per-token function — and the compiled ``ep.all_to_all``
+    payload equals the static capacity-factored schedule (two
+    independent readers of the same wire)."""
+    from apex_tpu import telemetry
+    from apex_tpu.telemetry import events as tel_events
+
+    def run_twin():
+        plan = pm.Plan(dp=N_DEV)
+        toks = _tokens()
+        with plan.apply() as mesh:
+            carry, step, _ = spmd._build_ep_step(
+                CFG, mesh, plan, GB, 1e-2, False)
+            losses = []
+            for _ in range(6):
+                carry, loss = step(carry, toks)
+                losses.append(float(loss))
+        return losses
+
+    sink = telemetry.MemorySink()
+    reg = telemetry.Registry(sink=sink, flush_interval=0,
+                             rank0_only=False, run_id="t", memory=False)
+    prev = tel_events.set_default(reg)
+    try:
+        losses, _, info = _run(pm.Plan(dp=4, ep=2), meter=True)
+    finally:
+        tel_events.set_default(prev)
+    _assert_fp32_tolerance(losses, run_twin())
+    assert info["engine"] == "shard_map.ep"
+    assert info["experts"] == pm.EP_DEFAULT_EXPERTS
+    a2a = info["metered"]["all-to-all"]
+    assert int(a2a["logical_bytes"]) == \
+        int(info["ep_wire"]["logical_bytes"])
+    vals = reg.read()
+    assert vals["ep.all_to_all_bytes"] == int(a2a["logical_bytes"])
+
+
 def test_amp_bf16_model_copy_over_fp32_master():
     """O2-style master weights through the GSPMD engine: bf16 model
     copy/activations, fp32 master stays authoritative and finite."""

@@ -549,18 +549,19 @@ def plan_violations(artifact) -> list:
             win = node.get("measured_winner")
             if isinstance(win, dict) and (
                     win.get("tp", 1) > 1 or win.get("sp", 1) > 1
-                    or win.get("zero")):
+                    or win.get("pp_stages", 1) > 1
+                    or win.get("ep", 1) > 1 or win.get("zero")):
                 if not any(r.get("knobs") == win for r in rows):
                     out.append(
                         f"{path}: measured_winner engages "
-                        "tp/sp/zero but no measured row carries those "
-                        "knobs — prediction-only winner")
+                        "tp/sp/pp/ep/zero but no measured row carries "
+                        "those knobs — prediction-only winner")
             # the per-family one-point calibration must hold for the
             # model-parallel families the engine measured (anchors read
             # 0 by construction; non-anchor rows are the real check)
             for r in rows:
                 ferr = r.get("family_calibration_error_pct")
-                if r.get("family") in ("tp", "sp") and \
+                if r.get("family") in ("tp", "sp", "pp", "ep") and \
                         isinstance(ferr, (int, float)) and ferr > 25.0:
                     out.append(
                         f"{path}: {r.get('plan')} family calibration "
@@ -950,6 +951,10 @@ def decide(bench, kern):
                     prof["plan_sp"] = int(kn.get("sp", 1))
                     prof["plan_sp_strategy"] = kn.get("sp_strategy",
                                                       "none")
+                    prof["plan_pp_stages"] = int(kn.get("pp_stages", 1))
+                    prof["plan_pp_microbatches"] = int(
+                        kn.get("pp_microbatches", 1))
+                    prof["plan_ep"] = int(kn.get("ep", 1))
                     prof["plan_zero"] = bool(kn.get("zero", False))
                     prof["plan_update_sharding"] = kn.get(
                         "update_sharding", "off")

@@ -164,12 +164,13 @@ def check_vmem_budget(tiny):
 
 
 def check_spmd_compile(tiny):
-    """SPMD step-engine compile smoke (ISSUE 12): every plan family —
-    dp x tp (GSPMD jit), dp x sp ring, dp x sp ulysses, zero1 update
-    sharding, contrib ZeRO — builds and runs one tiny train step on a
-    2x2 mesh (4 devices; smaller device counts degrade to the
-    factorizations that fit).  Value is the count of families that
-    failed to build/run (0.0 = all compiled); a toolchain where a
+    """SPMD step-engine compile smoke (ISSUE 12, pp/ep per ISSUE 17):
+    every plan family — dp x tp (GSPMD jit), dp x sp ring, dp x sp
+    ulysses, dp x pp (GPipe stages), dp x ep (switch-MoE experts),
+    zero1 update sharding, contrib ZeRO — builds and runs one tiny
+    train step on a 2x2 mesh (4 devices; smaller device counts degrade
+    to the factorizations that fit).  Value is the count of families
+    that failed to build/run (0.0 = all compiled); a toolchain where a
     family's engine cannot even compile must fail the smoke before a
     capture window is spent measuring it.  The tiny and production
     variants run the same logic — the engine's cost is compile time,
@@ -181,7 +182,9 @@ def check_spmd_compile(tiny):
     from apex_tpu.parallel import spmd
 
     n = len(jax.devices())
-    cfg = TransformerConfig(vocab_size=64, max_len=16, num_layers=1,
+    # two layers so a 2-stage pipeline divides evenly; all other
+    # families are layer-count agnostic
+    cfg = TransformerConfig(vocab_size=64, max_len=16, num_layers=2,
                             d_model=32, num_heads=2, d_ff=64,
                             xent_impl="xla")
     gb = 4
@@ -190,6 +193,8 @@ def check_spmd_compile(tiny):
         plans += [pm.Plan(dp=2, tp=2),
                   pm.Plan(dp=2, sp=2, sp_strategy="ring"),
                   pm.Plan(dp=2, sp=2, sp_strategy="ulysses"),
+                  pm.Plan(dp=2, pp_stages=2, pp_microbatches=2),
+                  pm.Plan(dp=2, ep=2),
                   pm.Plan(dp=4, update_sharding="zero1"),
                   pm.Plan(dp=4, zero=True)]
     elif n >= 2:

@@ -163,6 +163,16 @@ OVERLAP_PROFILE=${APEX_WATCH_OVERLAP_PROFILE:-OVERLAP_PROFILE_r5}
 OVERLAP_CMD=${APEX_WATCH_OVERLAP_CMD-"APEX_BENCH_PROFILE_DIR=$OVERLAP_PROFILE python bench.py --overlap"}
 OVERLAP_JSON=${APEX_WATCH_OVERLAP_JSON:-OVERLAP_AB_r5.json}
 OVERLAP_TO=${APEX_WATCH_OVERLAP_TO:-400}
+# stage 2h: pipeline/expert engine A/B (PR 17) — the flagship step dp
+# vs dp x pp (GPipe stages, metered ppermute wire vs the static
+# schedule + the pipeline_bubble_fraction the goodput ledger carves)
+# and dp-MoE vs dp x ep (switch-MoE router all_to_all wire vs its
+# schedule), loss parity per family in one artifact; feeds
+# apply_perf_results' plan_pp_*/plan_ep round-trip evidence.
+# ${VAR-default}: an explicitly EMPTY override disables the stage
+PPEP_CMD=${APEX_WATCH_PPEP_CMD-"python bench.py --ppep"}
+PPEP_JSON=${APEX_WATCH_PPEP_JSON:-PPEP_AB_r5.json}
+PPEP_TO=${APEX_WATCH_PPEP_TO:-400}
 # stage 4b: bench-trend / goodput regression watchdog (ISSUE 15) —
 # ingest the committed BENCH_r*/BENCH_TPU_r* trajectory plus any
 # GOODPUT*.json run ledgers and flag per-leg step-time/MFU/goodput
@@ -396,6 +406,21 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$OVERLAP_JSON".run
       fi
       echo "$(date +%H:%M:%S) overlap_ab A/B done rc=$rco" >> "$LOG"
+    fi
+    # ---- stage 2h: pipeline/expert engine A/B (best-effort, short) ----
+    if [ -n "$PPEP_CMD" ] && [ ! -s "$PPEP_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$PPEP_TO" bash -c "$PPEP_CMD" > "$PPEP_JSON".run 2>> "$LOG"
+      rcpp=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span ppep_ab "$t0" "$rcpp"
+      stage_mem
+      if [ $rcpp -eq 0 ] && [ -s "$PPEP_JSON".run ]; then
+        mv "$PPEP_JSON".run "$PPEP_JSON"
+      else
+        # a wedged/failed A/B never leaves a truncated artifact behind
+        rm -f "$PPEP_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) ppep_ab A/B done rc=$rcpp" >> "$LOG"
     fi
     # ---- stage 3a: guard-driven resumable train (incremental) ----
     # BEFORE the all-or-nothing save/resume leg: the guard leg makes
