@@ -87,8 +87,9 @@ from typing import Optional
 
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
-__all__ = ["build_plan_step", "plan_param_pspecs", "compiled_collectives",
-           "meter_compiled_collectives", "SPMD_FAMILIES"]
+__all__ = ["build_plan_step", "plan_param_pspecs", "serve_shardings",
+           "compiled_collectives", "meter_compiled_collectives",
+           "SPMD_FAMILIES"]
 
 #: plan families the engine materializes (Plan.family values)
 SPMD_FAMILIES = ("dp", "tp", "sp", "zero", "pp", "ep")
@@ -105,6 +106,40 @@ def plan_param_pspecs(cfg, plan):
         return transformer_pspecs(cfg, dp=DATA_AXIS, tp=MODEL_AXIS)
     params = transformer_init(jax.random.PRNGKey(0), cfg)
     return jax.tree_util.tree_map(lambda _: P(), params)
+
+
+def serve_shardings(mesh, cfg, *, packed):
+    """NamedSharding trees for the inference engine's compiled steps
+    (``serve.engine.InferenceEngine``): Megatron tensor-parallel param
+    specs over the mesh's ``model`` axis plus the KV pools sharded on
+    their head axis — ``(L, pages, page_size, H, hd)`` splits dim 3 —
+    so each shard scatters/gathers only its own heads and XLA derives
+    the attention psums, the PR 12 consistent-SPMD posture.
+
+    ``packed`` is the engine's O-level param pytree.  Only a raw dict
+    tree (fp32/bf16) takes the tensor-parallel specs; the int8 packed
+    ``(q, scales)`` leaf list replicates — block-scale codes don't
+    slice along Megatron dims (an accepted simplification, the pools
+    still shard).  Returns ``{"params": ..., "kv": ...}``."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    tp = int(mesh.shape.get(MODEL_AXIS, 1))
+    rep = NamedSharding(mesh, P())
+    if tp > 1 and cfg.num_heads % tp:
+        raise ValueError(f"num_heads {cfg.num_heads} not divisible by "
+                         f"model-axis size {tp}")
+    if tp > 1 and isinstance(packed, dict):
+        from ..models import transformer_pspecs
+        pspecs = transformer_pspecs(cfg, dp=DATA_AXIS, tp=MODEL_AXIS)
+        params = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), pspecs,
+            is_leaf=lambda x: isinstance(x, P))
+        kv = NamedSharding(mesh, P(None, None, None, MODEL_AXIS, None))
+    else:
+        params = jax.tree_util.tree_map(lambda _: rep, packed)
+        kv = rep
+    return {"params": params, "kv": kv}
 
 
 # ---------------------------------------------------------------------------

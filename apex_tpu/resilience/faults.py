@@ -16,6 +16,7 @@ Spec grammar (config string or the ``APEX_TPU_FAULTS`` env var)::
     entry      := KIND@STEP [ xCOUNT ] [ :ARG ] | seed=N
     KIND       := nan | inf | preempt | loader_stall | collective_fail
                   | oom | resize | shard_corrupt | index_missing
+                  | request_flood
                   (aliases: nan_grads -> nan, inf_grads -> inf,
                    sigterm -> preempt)
     STEP       := first step (0-based) the fault is armed at
@@ -24,6 +25,8 @@ Spec grammar (config string or the ``APEX_TPU_FAULTS`` env var)::
     COUNT      := consecutive steps it stays armed (default 1)
     ARG        := kind-specific float (loader_stall: seconds to stall;
                   resize: REQUIRED target world size, e.g. resize@40:4;
+                  request_flood: REQUIRED burst size K,
+                  e.g. request_flood@8:16;
                   shard_corrupt: byte offset to flip, default mid-file)
 
 Fault kinds and their consumers:
@@ -73,6 +76,14 @@ Fault kinds and their consumers:
     driving the degrade-to-directory-scan path and its typed
     ``IndexMissingWarning`` — the manifest-loss posture applied to the
     data plane.
+  * ``request_flood`` — ``request_flood@N:K`` dumps ``K`` synthetic
+    inference requests into the serving admission queue at decode step
+    ``N`` (``serve.schedule.ContinuousBatcher`` consumes it), driving
+    KV-page-pool exhaustion through the typed
+    ``KVCacheExhaustedError`` → request-shedding path — never an OOM,
+    never a silent drop; the serve ledger meters the shed time in its
+    ``shed`` class.  ``K`` is required and must be a positive integer,
+    like ``resize``'s target.
 
 Every kind above also declares the goodput-ledger badput class its
 injection is expected to land in (``telemetry.goodput.FAULT_BADPUT``;
@@ -92,7 +103,7 @@ import time
 from typing import List, Optional, Tuple
 
 KINDS = ("nan", "inf", "preempt", "loader_stall", "collective_fail", "oom",
-         "resize", "shard_corrupt", "index_missing")
+         "resize", "shard_corrupt", "index_missing", "request_flood")
 _ALIASES = {"nan_grads": "nan", "inf_grads": "inf", "sigterm": "preempt"}
 
 _ENTRY = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
@@ -210,6 +221,10 @@ def parse(spec: str) -> FaultPlan:
             raise FaultError(
                 f"resize needs a positive integer target world size: "
                 f"resize@STEP:M (got {entry!r})")
+        if kind == "request_flood" and (arg < 1 or arg != int(arg)):
+            raise FaultError(
+                f"request_flood needs a positive integer burst size: "
+                f"request_flood@STEP:K (got {entry!r})")
         specs.append(FaultSpec(
             kind=kind, step=int(m.group("step")),
             count=int(m.group("count") or 1), arg=arg))

@@ -1,0 +1,12 @@
+"""apex_tpu.serve — continuous-batching inference engine (ISSUE 18).
+
+Paged KV cache (:mod:`.cache`), greedy/sampled decode (:mod:`.sample`),
+compiled prefill/decode steps with inference O-levels (:mod:`.engine`),
+and the continuous-batching scheduler (:mod:`.schedule`).  The
+per-request latency ledger lives with the rest of the jax-free tooling
+layer as :mod:`apex_tpu.telemetry.serve_ledger`.
+"""
+from .cache import CacheConfig, KVCacheExhaustedError, PagePool  # noqa: F401
+from .engine import OLEVELS, InferenceEngine, prepare_olevel  # noqa: F401
+from .sample import request_key, sample_batch, sample_token  # noqa: F401
+from .schedule import ContinuousBatcher, Request, ServedResult  # noqa: F401

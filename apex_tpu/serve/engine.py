@@ -1,0 +1,384 @@
+"""Inference engine: prefill + paged single-token decode steps, with
+inference O-levels (ISSUE 18).
+
+Two compiled functions serve every request:
+
+  * **prefill** — one request at a time, the full-prompt forward at a
+    FIXED width of ``cache.max_ctx`` (prompt right-padded with token 0).
+    It mirrors :func:`~apex_tpu.models.transformer.transformer_apply`
+    expression-for-expression (same einsums, same shapes, causal), so
+    its logits are the one-shot forward's logits BITWISE; along the way
+    it captures every layer's K/V and scatters them into the request's
+    pages in one write.  ``attn_impl="fast"`` routes the attention core
+    through the contrib flash kernel exactly as the trainer does.
+  * **decode** — a fixed batch of ``decode_width`` single tokens, one
+    per continuous-batching slot.  Each slot's K/V for its new token is
+    scattered into its current page, then attention GATHERS the slot's
+    whole page table back into a contiguous ``(max_ctx,)`` key window
+    and masks positions beyond the slot's context to -inf — stale or
+    scratch pages contribute exactly 0, which is what makes mid-flight
+    eviction/recycling bitwise-invisible to surviving slots.
+
+The fp32 bitwise contract (decode logits == the one-shot forward's row
+for that position, ``tests/L0/test_serve.py``) pins two shape choices
+on the CPU backend, where XLA picks different dot algorithms by shape:
+projections run as (W, D) x (D, E) matmuls with ``decode_width >= 2``
+(a single-row gemv reduces in a different order than the full
+forward's gemm rows), and the score einsum runs with the slot's query
+row DUPLICATED to length 2, then sliced back — measured on this
+backend: M>=2 gemm rows are bitwise-stable across M, M=1 is not.
+
+Inference O-levels reuse the amp cast machinery
+(``amp.frontend._cast_floats``) and the wire codec
+(``parallel.collectives.quantize_blockscale``):
+
+    fp32   everything float32 (the numerics oracle)
+    bf16   weights + activations bf16 — the O4 posture: no loss scale,
+           bf16 keeps fp32's dynamic range
+    int8   >=2-D weights stored as int8 block-scaled codes (+1 fp32
+           scale per 128 block), dequantized ON READ inside the step to
+           bf16 compute; vectors (LN gains, biases) stay bf16.  The
+           metered ``compression_ratio`` lands in the serve ledger.
+
+With a ``mesh`` (a ``model`` axis), both steps jit under GSPMD with
+Megatron tensor-parallel param specs (``transformer_pspecs``) and the
+KV pools sharded over the head axis — the PR 12 consistent-SPMD
+posture; XLA inserts the psums (``parallel.spmd.serve_kv_pspec``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import TransformerConfig
+from ..normalization.fused_layer_norm import fused_layer_norm_affine
+from .cache import CacheConfig
+from .sample import request_key, sample_batch, sample_token
+
+__all__ = ["OLEVELS", "InferenceEngine", "prepare_olevel"]
+
+OLEVELS = ("fp32", "bf16", "int8")
+
+
+# ---------------------------------------------------------------------------
+# O-levels: fp32 / bf16 casts via amp, int8 block-scale with dequant-on-read
+# ---------------------------------------------------------------------------
+
+def prepare_olevel(params, olevel: str):
+    """-> (packed_params, unpack_fn, compute_dtype, compression_ratio).
+
+    ``packed_params`` is a pytree jit can thread; ``unpack_fn(packed)``
+    runs INSIDE the step and yields the original param structure in the
+    compute dtype (the int8 dequant-on-read point).  ``compression_
+    ratio`` is fp32 bytes / stored bytes (None below int8)."""
+    from ..amp.frontend import _cast_floats
+    if olevel not in OLEVELS:
+        raise ValueError(f"olevel must be one of {OLEVELS}, got {olevel!r}")
+    if olevel == "fp32":
+        return _cast_floats(params, jnp.float32), (lambda p: p), \
+            jnp.float32, None
+    if olevel == "bf16":
+        return _cast_floats(params, jnp.bfloat16), (lambda p: p), \
+            jnp.bfloat16, None
+
+    # int8: quantize every >=2-D float leaf through the wire codec
+    from ..parallel.collectives import (dequantize_blockscale,
+                                        quantize_blockscale)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    packed, meta = [], []
+    bytes_fp32 = bytes_stored = 0
+    for leaf in leaves:
+        isf = jnp.issubdtype(leaf.dtype, jnp.floating)
+        bytes_fp32 += leaf.size * 4 if isf else leaf.size * leaf.dtype.itemsize
+        if isf and leaf.ndim >= 2:
+            q, scales = quantize_blockscale(
+                leaf.astype(jnp.float32).reshape(-1))
+            packed.append((q, scales))
+            meta.append(("q", leaf.shape, leaf.size))
+            bytes_stored += q.size + scales.size * 4
+        elif isf:
+            cast = leaf.astype(jnp.bfloat16)
+            packed.append(cast)
+            meta.append(("raw", None, None))
+            bytes_stored += cast.size * 2
+        else:
+            packed.append(leaf)
+            meta.append(("raw", None, None))
+            bytes_stored += leaf.size * leaf.dtype.itemsize
+
+    def unpack(packed_leaves):
+        out = []
+        for entry, (kind, shape, n) in zip(packed_leaves, meta):
+            if kind == "q":
+                q, scales = entry
+                out.append(dequantize_blockscale(q, scales, n)
+                           .reshape(shape).astype(jnp.bfloat16))
+            else:
+                out.append(entry)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return packed, unpack, jnp.bfloat16, bytes_fp32 / max(bytes_stored, 1)
+
+
+# ---------------------------------------------------------------------------
+# the layer math — expression-level mirror of models.transformer
+# ---------------------------------------------------------------------------
+
+def _prefill_attention(h, lp, cfg: TransformerConfig):
+    """The ``_attention`` default/fast paths, returning (out, k, v) with
+    k/v in (B, S, H, hd) layout for the page scatter.  Causal, no mask,
+    no dropout (inference)."""
+    B, S, D = h.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    qkv = jnp.einsum("bsd,de->bse", h, lp["wqkv"].astype(h.dtype)) \
+        + lp["bqkv"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    kv_k = k.reshape(B, S, H, hd)
+    kv_v = v.reshape(B, S, H, hd)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = kv_k.transpose(0, 2, 1, 3)
+    v = kv_v.transpose(0, 2, 1, 3)
+    if cfg.attn_impl == "fast":
+        from ..contrib.multihead_attn.flash import flash_attention
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        qf = (q.astype(jnp.float32) * scale).astype(h.dtype) \
+            .reshape(B * H, S, hd)
+        ctx = flash_attention(qf, k.reshape(B * H, S, hd),
+                              v.reshape(B * H, S, hd),
+                              jnp.zeros((1, 1, S), jnp.float32),
+                              seed=0, causal=True, dropout_rate=0.0,
+                              heads=H)
+        ctx = ctx.reshape(B, H, S, hd)
+    else:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(hd, h.dtype))
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(causal[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+    out = jnp.einsum("bsd,de->bse", ctx, lp["wo"].astype(h.dtype)) \
+        + lp["bo"].astype(h.dtype)
+    return out, kv_k, kv_v
+
+
+def _mlp(x, lp, cfg: TransformerConfig):
+    dt = x.dtype
+    h = fused_layer_norm_affine(x, lp["ln2_g"].astype(dt),
+                                lp["ln2_b"].astype(dt), (cfg.d_model,))
+    h = jnp.einsum("bsd,df->bsf", h, lp["w1"].astype(dt)) \
+        + lp["b1"].astype(dt)
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("bsf,fd->bsd", h, lp["w2"].astype(dt)) \
+        + lp["b2"].astype(dt)
+    return x + h
+
+
+def _embed(params, tokens, pos_rows, cfg: TransformerConfig):
+    emb = params["embed"]
+    dt = cfg.dtype
+    x = emb["tok"][tokens].astype(dt) + pos_rows.astype(dt)
+    return fused_layer_norm_affine(x, emb["ln_g"].astype(dt),
+                                   emb["ln_b"].astype(dt), (cfg.d_model,))
+
+
+def _head(params, x, cfg: TransformerConfig):
+    dt = cfg.dtype
+    hd = params["head"]
+    x = fused_layer_norm_affine(x, hd["ln_g"].astype(dt),
+                                hd["ln_b"].astype(dt), (cfg.d_model,))
+    w_out = (params["embed"]["tok"].T if cfg.tie_embeddings
+             else hd["out"]).astype(dt)
+    return jnp.einsum("bsd,dv->bsv", x, w_out)
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class InferenceEngine:
+    """Owns the KV pools and the two compiled step functions.  All
+    device work; ZERO host syncs — every method returns device arrays
+    the scheduler batches into its one boundary read."""
+
+    def __init__(self, params, model_cfg: TransformerConfig, *,
+                 cache: Optional[CacheConfig] = None,
+                 olevel: str = "bf16", decode_width: int = 4,
+                 mesh=None):
+        cache = cache or CacheConfig()
+        if decode_width < 2:
+            raise ValueError(
+                "decode_width must be >= 2: single-row projections take "
+                "a different (gemv) reduction order than the full "
+                "forward's gemm rows, breaking the bitwise contract")
+        if cache.max_ctx > model_cfg.max_len:
+            raise ValueError(f"cache.max_ctx {cache.max_ctx} exceeds "
+                             f"model max_len {model_cfg.max_len}")
+        if model_cfg.num_heads * model_cfg.head_dim != model_cfg.d_model:
+            raise ValueError("d_model must equal num_heads * head_dim")
+        self.cache = cache
+        self.decode_width = int(decode_width)
+        self.olevel = str(olevel)
+        self.mesh = mesh
+        self._packed, self._unpack, dt, self.compression_ratio = \
+            prepare_olevel(params, olevel)
+        self.cfg = dataclasses.replace(
+            model_cfg, dtype=dt, causal=True, dropout=0.0, remat=False,
+            scan_unroll=1)
+        L, H, hd = self.cfg.num_layers, self.cfg.num_heads, self.cfg.head_dim
+        pool_shape = (L, cache.num_pages, cache.page_size, H, hd)
+        self.k_pool = jnp.zeros(pool_shape, dt)
+        self.v_pool = jnp.zeros(pool_shape, dt)
+        self._build_steps()
+
+    # -- compiled steps ------------------------------------------------------
+    def _build_steps(self):
+        cfg, cache, W = self.cfg, self.cache, self.decode_width
+        unpack = self._unpack
+        PPR, PS, S = cache.pages_per_request, cache.page_size, cache.max_ctx
+
+        def prefill_fn(packed, k_pool, v_pool, tokens, prompt_len,
+                       page_table, seed, temperature, top_k):
+            params = unpack(packed)
+            pos_rows = params["embed"]["pos"][:S][None]
+            x = _embed(params, tokens, pos_rows, cfg)
+
+            def body(carry, lp):
+                h = fused_layer_norm_affine(
+                    carry, lp["ln1_g"].astype(carry.dtype),
+                    lp["ln1_b"].astype(carry.dtype), (cfg.d_model,))
+                out, kk, vv = _prefill_attention(h, lp, cfg)
+                return _mlp(carry + out, lp, cfg), (kk[0], vv[0])
+
+            x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+            # one whole-page scatter per pool: (L, S, H, hd) ->
+            # (L, PPR, PS, H, hd) into this request's pages
+            ks = ks.reshape(ks.shape[0], PPR, PS, *ks.shape[2:])
+            vs = vs.reshape(vs.shape[0], PPR, PS, *vs.shape[2:])
+            k_pool = k_pool.at[:, page_table].set(ks)
+            v_pool = v_pool.at[:, page_table].set(vs)
+            # the barrier keeps the row slice below from fusing INTO the
+            # head matmul (a fused slice computes just that row as a
+            # differently-rounded gemv — measured bitwise break on CPU)
+            logits = jax.lax.optimization_barrier(
+                _head(params, x, cfg)[0])              # (S, V)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, prompt_len - 1, 1, axis=0)[0]  # (V,)
+            first_tok = sample_token(
+                last, request_key(seed, prompt_len), temperature, top_k)
+            return first_tok, last, k_pool, v_pool
+
+        def decode_fn(packed, k_pool, v_pool, tokens, positions,
+                      page_tables, seeds, temperatures, top_ks):
+            params = unpack(packed)
+            pos_rows = jnp.take(params["embed"]["pos"], positions, axis=0)
+            # carry (1, W, D) — slots on the SEQUENCE dim, so every
+            # "bsd,de->bse" projection is a true (W, D) x (D, E) gemm;
+            # a (W, 1, D) carry makes them per-batch M=1 gemvs, which
+            # round differently (measured bitwise break on CPU)
+            x = _embed(params, tokens, pos_rows, cfg)[None]   # (1,W,D)
+            pages = jnp.take_along_axis(
+                page_tables, (positions // PS)[:, None], axis=1)[:, 0]
+            slots = positions % PS
+            H, hd = cfg.num_heads, cfg.head_dim
+
+            def body(carry, layer_in):
+                lp, kp, vp = layer_in
+                dt = carry.dtype
+                h = fused_layer_norm_affine(
+                    carry, lp["ln1_g"].astype(dt), lp["ln1_b"].astype(dt),
+                    (cfg.d_model,))
+                qkv = jnp.einsum("bsd,de->bse", h, lp["wqkv"].astype(dt)) \
+                    + lp["bqkv"].astype(dt)
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                q = q.reshape(1, W, H, hd).transpose(1, 2, 0, 3)  # (W,H,1,hd)
+                # append this token's K/V to each slot's current page
+                kp = kp.at[pages, slots].set(k.reshape(W, H, hd))
+                vp = vp.at[pages, slots].set(v.reshape(W, H, hd))
+                # gather-over-pages: the slot's table back to a
+                # contiguous (max_ctx,) key window
+                kg = kp[page_tables].reshape(W, S, H, hd) \
+                    .transpose(0, 2, 1, 3)
+                vg = vp[page_tables].reshape(W, S, H, hd) \
+                    .transpose(0, 2, 1, 3)
+                # duplicated query row: an M=2 gemm reduces like the
+                # full forward's rows; M=1 does not (see module doc)
+                q2 = jnp.concatenate([q, q], axis=2)
+                scores = jnp.einsum("bhqd,bhkd->bhqk", q2, kg)[:, :, :1] \
+                    / jnp.sqrt(jnp.asarray(hd, dt))
+                valid = jnp.arange(S)[None, None, None, :] \
+                    <= positions[:, None, None, None]
+                scores = jnp.where(valid, scores, -jnp.inf)
+                probs = jax.nn.softmax(scores.astype(jnp.float32),
+                                       axis=-1).astype(dt)
+                ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, vg)
+                ctx = ctx.transpose(2, 0, 1, 3).reshape(1, W, cfg.d_model)
+                out = jnp.einsum("bsd,de->bse", ctx, lp["wo"].astype(dt)) \
+                    + lp["bo"].astype(dt)
+                return _mlp(carry + out, lp, cfg), (kp, vp)
+
+            x, (k_pool, v_pool) = jax.lax.scan(
+                body, x, (params["layers"], k_pool, v_pool))
+            # barrier: same anti-fusion posture as prefill's head
+            logits = jax.lax.optimization_barrier(
+                _head(params, x, cfg)[0])              # (W, V)
+            toks = sample_batch(logits, seeds, positions + 1,
+                                temperatures, top_ks)
+            return toks, logits, k_pool, v_pool
+
+        if self.mesh is not None:
+            from ..parallel import spmd as _spmd
+            shard = _spmd.serve_shardings(self.mesh, self.cfg,
+                                          packed=self._packed)
+            rep = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec())
+            rep_tree = lambda tree: jax.tree_util.tree_map(
+                lambda _: rep, tree)
+            self._prefill = jax.jit(
+                prefill_fn,
+                in_shardings=(shard["params"], shard["kv"], shard["kv"],
+                              rep, rep, rep, rep, rep, rep),
+                out_shardings=(rep, rep, shard["kv"], shard["kv"]))
+            self._decode = jax.jit(
+                decode_fn,
+                in_shardings=(shard["params"], shard["kv"], shard["kv"],
+                              rep, rep, rep, rep, rep, rep),
+                out_shardings=(rep, rep, shard["kv"], shard["kv"]))
+            del rep_tree
+        else:
+            self._prefill = jax.jit(prefill_fn)
+            self._decode = jax.jit(decode_fn)
+
+    # -- public surface (device in, device out; no syncs) --------------------
+    def prefill(self, tokens, prompt_len, page_table, seed,
+                temperature=0.0, top_k=0):
+        """Run one request's prompt through the fixed-width prefill.
+        ``tokens``: (max_ctx,) int32, right-padded with 0.  Returns
+        (first_token, last_logits) device arrays; pools updated."""
+        first, last, self.k_pool, self.v_pool = self._prefill(
+            self._packed, self.k_pool, self.v_pool,
+            jnp.asarray(tokens, jnp.int32)[None],
+            jnp.int32(prompt_len),
+            jnp.asarray(page_table, jnp.int32),
+            jnp.int32(seed), jnp.float32(temperature), jnp.int32(top_k))
+        return first, last
+
+    def decode_step(self, tokens, positions, page_tables, seeds,
+                    temperatures, top_ks):
+        """One continuous-batching decode step over all slots.  Every
+        arg is (W,)-shaped per-slot state ((W, PPR) for the tables).
+        Returns (next_tokens, logits) device arrays; pools updated."""
+        toks, logits, self.k_pool, self.v_pool = self._decode(
+            self._packed, self.k_pool, self.v_pool,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(page_tables, jnp.int32),
+            jnp.asarray(seeds, jnp.int32),
+            jnp.asarray(temperatures, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32))
+        return toks, logits

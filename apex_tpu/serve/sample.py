@@ -1,0 +1,56 @@
+"""Greedy and temperature/top-k token sampling with per-request PRNG
+keys (ISSUE 18).
+
+Every request carries an integer ``seed``; the key for the token
+generated at position ``pos`` is ``fold_in(PRNGKey(seed), pos)`` — a
+pure function of (seed, position), independent of which continuous-
+batching slot the request occupies or who shares the batch.  That is
+the deterministic-replay contract: replaying a request alone reproduces
+its sampled tokens bitwise, asserted by ``tests/L0/test_serve.py``.
+
+``temperature == 0`` is greedy (argmax); ``top_k == 0`` disables the
+top-k filter.  Both knobs are per-request traced scalars so one
+compiled decode step serves mixed sampling configs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["request_key", "sample_token", "sample_batch"]
+
+
+def request_key(seed, pos):
+    """The PRNG key for the token generated at ``pos`` of the request
+    seeded ``seed`` (both may be traced int32)."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+
+
+def sample_token(logits, key, temperature, top_k):
+    """One token id from ``logits`` (V,) — greedy when
+    ``temperature <= 0``, else temperature-scaled categorical over the
+    ``top_k``-filtered distribution (``top_k <= 0`` = no filter).
+
+    The filter keeps every logit >= the k-th largest (ties keep more
+    than k candidates — a deterministic, shape-static rule)."""
+    lg = logits.astype(jnp.float32)
+    v = lg.shape[-1]
+    greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    sorted_desc = jnp.sort(lg, axis=-1)[::-1]
+    k_idx = jnp.clip(top_k - 1, 0, v - 1)
+    thresh = jnp.where(top_k > 0, sorted_desc[k_idx], -jnp.inf)
+    filtered = jnp.where(lg >= thresh, lg, -jnp.inf)
+    temp = jnp.maximum(temperature.astype(jnp.float32)
+                       if hasattr(temperature, "astype")
+                       else jnp.float32(temperature), 1e-6)
+    sampled = jax.random.categorical(key, filtered / temp).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def sample_batch(logits, seeds, positions, temperatures, top_ks):
+    """Per-slot sampling over a decode batch: ``logits`` (W, V) with
+    per-request (W,) seeds / generated-token positions / temperatures /
+    top-k values.  vmapped :func:`sample_token` with per-request keys,
+    so each slot's token depends only on its own request state."""
+    keys = jax.vmap(request_key)(seeds, positions)
+    return jax.vmap(sample_token)(logits, keys, temperatures, top_ks)

@@ -1,0 +1,281 @@
+"""Continuous-batching scheduler (ISSUE 18): admission queue,
+prefill/decode interleaving at a fixed decode width, mid-flight
+eviction with page recycling, typed load shedding.
+
+The loop composes the engine's two compiled steps into vLLM-style
+continuous batching: each scheduler step (a) fires any scheduled
+``request_flood`` chaos, (b) admits queued requests into free decode
+slots — allocating their prompt pages and running prefill one request
+at a time, (c) grows each active slot's page table when its context
+crosses a page boundary — pool exhaustion here (or at admission) sheds
+the request via the typed :class:`~apex_tpu.serve.cache.
+KVCacheExhaustedError` path instead of OOMing, with its pages recycled
+and the shed time metered, (d) runs ONE batched decode step over all
+active slots, and (e) performs the step's single batched host read.
+
+Host-read discipline: device values cross to the host in EXACTLY ONE
+``jax.device_get`` per scheduler step — the decode batch's sampled
+tokens plus any freshly prefilled first tokens, read together at the
+step boundary (the TrainGuard batched-health-check posture; this
+module is the sanctioned call site in the host-sync lint, and every
+page-table/position update is host arithmetic that needs no sync).
+
+Every request's life is metered in the per-request latency ledger
+(:mod:`apex_tpu.telemetry.serve_ledger`): ``queue`` from submit to
+admission, ``prefill`` to its first boundary, ``decode`` per step, and
+a ``shed`` tail when load shedding ends it early.  Tracer spans wrap
+each prefill (``serve.prefill``) and each decode step
+(``serve.decode``); admissions/finishes/sheds emit registry events.
+
+Determinism: sampling keys are ``fold_in(PRNGKey(request.seed),
+position)`` — a pure function of request state — and every engine op
+is row-independent across slots, so a request's output is bitwise
+identical whether it shares the batch, gets its pages recycled from an
+evicted neighbor, or replays alone (asserted by
+``tests/L0/test_serve.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..resilience import faults as _faults
+from ..telemetry.serve_ledger import ServeLedger
+from .cache import KVCacheExhaustedError, PagePool
+
+__all__ = ["Request", "ServedResult", "ContinuousBatcher"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One inference request.  ``temperature == 0`` = greedy;
+    ``seed`` drives the per-request sampling PRNG (deterministic
+    replay); ``eos_id`` stops generation early when sampled."""
+    rid: str
+    prompt: List[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    eos_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ServedResult:
+    rid: str
+    status: str                  # "done" | "shed"
+    tokens: List[int]            # generated tokens (incl. eos if hit)
+    prompt_len: int
+    reason: Optional[str] = None
+
+
+class _Slot:
+    __slots__ = ("req", "pages", "pos", "cur_token", "generated",
+                 "pending_first")
+
+    def __init__(self, req, pages):
+        self.req = req
+        self.pages = pages            # allocated pool pages, in order
+        self.pos = len(req.prompt)    # position of the next consumed token
+        self.cur_token = None         # host int once the boundary read it
+        self.generated: List[int] = []
+        self.pending_first = None     # device first token from prefill
+
+
+class ContinuousBatcher:
+    """Drives an :class:`~apex_tpu.serve.engine.InferenceEngine`."""
+
+    def __init__(self, engine, *, ledger: Optional[ServeLedger] = None,
+                 registry=None, tracer=None):
+        self.engine = engine
+        self.cache = engine.cache
+        self.pool = PagePool(self.cache)
+        self.ledger = ledger if ledger is not None else ServeLedger()
+        self.registry = registry
+        self.tracer = tracer
+        self.queue: List[Request] = []
+        self.slots: List[Optional[_Slot]] = [None] * engine.decode_width
+        self.results: Dict[str, ServedResult] = {}
+        self._step_idx = 0
+        self._flood_seq = 0
+
+    # -- bookkeeping helpers -------------------------------------------------
+    def _event(self, name: str, **fields) -> None:
+        if self.registry is not None and getattr(self.registry, "enabled",
+                                                 False):
+            self.registry.event(name, **fields)
+
+    def _span(self, name: str, **attrs):
+        if self.tracer is not None:
+            return self.tracer.span(name, **attrs)
+        import contextlib
+        return contextlib.nullcontext()
+
+    def submit(self, req: Request) -> None:
+        self.queue.append(req)
+        self.ledger.submit(req.rid, prompt_len=len(req.prompt))
+        self._event("serve.submit", rid=req.rid)
+
+    def _shed(self, req: Request, reason: str,
+              pages: Optional[List[int]] = None) -> None:
+        """Typed load shedding: recycle any pages, meter the shed tail,
+        record the result — the request ends, the engine does not."""
+        if pages:
+            self.pool.free(pages)
+        self.ledger.finish(req.rid, status="shed")
+        self.results[req.rid] = ServedResult(
+            req.rid, "shed", [], len(req.prompt), reason=reason)
+        self._event("serve.shed", rid=req.rid, reason=reason)
+
+    def _finish(self, slot: _Slot, w: int) -> None:
+        self.pool.free(slot.pages)
+        self.slots[w] = None
+        self.ledger.finish(slot.req.rid, status="done")
+        self.results[slot.req.rid] = ServedResult(
+            slot.req.rid, "done", list(slot.generated),
+            len(slot.req.prompt))
+        self._event("serve.finish", rid=slot.req.rid,
+                    tokens=len(slot.generated))
+
+    def _slot_done(self, slot: _Slot, token: int) -> bool:
+        if slot.req.eos_id is not None and token == slot.req.eos_id:
+            return True
+        if len(slot.generated) >= slot.req.max_new_tokens:
+            return True
+        # context window full: the next token has nowhere to live
+        return slot.pos + 1 >= self.cache.max_ctx
+
+    # -- the chaos hook ------------------------------------------------------
+    def _maybe_flood(self) -> None:
+        plan = _faults.active_plan()
+        spec = plan.fire("request_flood", self._step_idx) if plan else None
+        if spec is None:
+            return
+        k = int(spec.arg)
+        for _ in range(k):
+            self._flood_seq += 1
+            rid = f"flood-{self._flood_seq}"
+            self.submit(Request(
+                rid=rid, prompt=[1] * min(4, self.cache.max_ctx - 1),
+                max_new_tokens=4, seed=1000 + self._flood_seq))
+        self._event("serve.request_flood", step=self._step_idx, count=k)
+        if self.tracer is not None:
+            self.tracer.instant("serve.request_flood",
+                                step=self._step_idx, count=k)
+
+    # -- one scheduler step --------------------------------------------------
+    def step(self) -> None:
+        self._maybe_flood()
+        admitted: List[int] = []
+
+        # admission: queued requests into free slots, one prefill each
+        free = [w for w, s in enumerate(self.slots) if s is None]
+        while self.queue and free:
+            req = self.queue.pop(0)
+            plen = len(req.prompt)
+            if not 0 < plen < self.cache.max_ctx:
+                self._shed(req, "prompt_too_long")
+                continue
+            try:
+                pages = self.pool.alloc(self.cache.pages_for(plen))
+            except KVCacheExhaustedError:
+                self._shed(req, "kv_cache_exhausted")
+                continue
+            w = free.pop(0)
+            slot = _Slot(req, pages)
+            self.slots[w] = slot
+            self.ledger.phase(req.rid, "prefill")
+            table = np.zeros(self.cache.pages_per_request, np.int32)
+            table[:len(pages)] = pages
+            tokens = np.zeros(self.cache.max_ctx, np.int32)
+            tokens[:plen] = req.prompt
+            with self._span("serve.prefill", rid=req.rid, prompt_len=plen):
+                first, _ = self.engine.prefill(
+                    tokens, plen, table, req.seed, req.temperature,
+                    req.top_k)
+            slot.pending_first = first
+            admitted.append(w)
+            self._event("serve.admit", rid=req.rid)
+
+        # page growth + the batched decode step over established slots
+        decoding: List[int] = []
+        for w, slot in enumerate(self.slots):
+            if slot is None or w in admitted or slot.cur_token is None:
+                continue
+            need = self.cache.pages_for(slot.pos + 1)
+            if need > len(slot.pages):
+                try:
+                    slot.pages += self.pool.alloc(need - len(slot.pages))
+                except KVCacheExhaustedError:
+                    req, pages = slot.req, slot.pages
+                    self.slots[w] = None
+                    self._shed(req, "kv_cache_exhausted", pages=pages)
+                    continue
+            decoding.append(w)
+
+        dec_out = None
+        if decoding:
+            W = self.engine.decode_width
+            PPR = self.cache.pages_per_request
+            toks = np.zeros(W, np.int32)
+            positions = np.zeros(W, np.int32)
+            tables = np.zeros((W, PPR), np.int32)
+            seeds = np.zeros(W, np.int32)
+            temps = np.zeros(W, np.float32)
+            topks = np.zeros(W, np.int32)
+            for w in decoding:
+                s = self.slots[w]
+                toks[w] = s.cur_token
+                positions[w] = s.pos
+                tables[w, :len(s.pages)] = s.pages
+                seeds[w] = s.req.seed
+                temps[w] = s.req.temperature
+                topks[w] = s.req.top_k
+            with self._span("serve.decode", step=self._step_idx,
+                            active=len(decoding)):
+                dec_out, _ = self.engine.decode_step(
+                    toks, positions, tables, seeds, temps, topks)
+
+        # THE step's one batched host read: decode tokens + first tokens
+        pending = [self.slots[w].pending_first for w in admitted]
+        if dec_out is not None or pending:
+            import jax
+            host = jax.device_get((dec_out, pending))
+            dec_host, first_host = host
+            for w in decoding:
+                s = self.slots[w]
+                tok = int(dec_host[w])
+                s.generated.append(tok)
+                s.cur_token = tok
+                s.pos += 1
+                self.ledger.note_tokens(s.req.rid, 1)
+                self.ledger.phase(s.req.rid, "decode")
+                if self._slot_done(s, tok):
+                    self._finish(s, w)
+            for w, first in zip(admitted, first_host):
+                s = self.slots[w]
+                tok = int(first)
+                s.pending_first = None
+                s.generated.append(tok)
+                s.cur_token = tok
+                self.ledger.note_first_token(s.req.rid)
+                self.ledger.note_tokens(s.req.rid, 1)
+                self.ledger.phase(s.req.rid, "decode")
+                if self._slot_done(s, tok):
+                    self._finish(s, w)
+        self._step_idx += 1
+
+    @property
+    def active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def run(self, max_steps: int = 100_000) -> Dict[str, ServedResult]:
+        """Step until the queue and every slot drain (or ``max_steps``,
+        a runaway backstop).  Returns rid -> :class:`ServedResult`."""
+        steps = 0
+        while (self.queue or self.active) and steps < max_steps:
+            self.step()
+            steps += 1
+        return self.results

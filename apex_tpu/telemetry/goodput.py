@@ -142,6 +142,11 @@ FAULT_BADPUT = {
     "shard_corrupt": ABORT,
     # index loss degrades to a (slower, warned) directory scan
     "index_missing": "data_stall",
+    # serving-plane fault: the training ledger never sees it (no train
+    # step stalls), so any residue is idle here — the SERVE ledger
+    # meters the real cost in its own ``shed`` class
+    # (telemetry.serve_ledger)
+    "request_flood": "idle",
 }
 
 #: span name -> ledger class.  Names NOT listed here (and not matching

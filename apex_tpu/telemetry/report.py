@@ -153,6 +153,14 @@ def summarize(records: List[dict]) -> dict:
         # exported gauges — wall-clock fraction that was productive
         # training, plus the per-class badput breakdown in ms
         "goodput_fraction": gauge_last("goodput.fraction"),
+        # serving (docs/serve.md): the per-request latency ledger's
+        # exported gauges — request counts (served/shed), tail latency,
+        # and decode throughput, mirrored next to the train-side lines
+        "serve_requests_served": gauge_last("serve.requests_served"),
+        "serve_requests_shed": gauge_last("serve.requests_shed"),
+        "serve_p50_ms": gauge_last("serve.p50_ms"),
+        "serve_p99_ms": gauge_last("serve.p99_ms"),
+        "serve_tokens_per_sec": gauge_last("serve.tokens_per_sec"),
         "badput_ms": {
             name[len("badput."):-len("_ms")]: recs[-1]["value"]
             for name, recs in metrics.items()
@@ -230,6 +238,16 @@ def format_summary(s: dict) -> str:
                      + ("  badput: " + "  ".join(
                          f"{k.replace('_', ' ')} {v:.1f}ms"
                          for k, v in bad) if bad else ""))
+    if s.get("serve_requests_served") is not None:
+        parts = [f"served {s['serve_requests_served']:.0f}",
+                 f"shed {s.get('serve_requests_shed') or 0:.0f}"]
+        if s.get("serve_p50_ms") is not None:
+            parts.append(f"p50 {s['serve_p50_ms']:.1f}ms")
+        if s.get("serve_p99_ms") is not None:
+            parts.append(f"p99 {s['serve_p99_ms']:.1f}ms")
+        if s.get("serve_tokens_per_sec") is not None:
+            parts.append(f"{s['serve_tokens_per_sec']:.1f} tok/s")
+        lines.append("  serving             " + "  ".join(parts))
     return "\n".join(lines)
 
 
@@ -345,6 +363,12 @@ def main(argv=None) -> int:
         # GOODPUT.json artifact or a run's exported gauges
         from . import goodput as _goodput
         return _goodput.cli(argv[1:])
+    if argv and argv[0] == "serve":
+        # `python -m apex_tpu.telemetry serve <SERVE.json|run-dir>`:
+        # the per-request latency ledger table — class breakdown,
+        # p50/p99/TTFT, shed counts — from a serving artifact
+        from . import serve_ledger as _serve_ledger
+        return _serve_ledger.cli(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.telemetry",

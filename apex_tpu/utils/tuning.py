@@ -138,6 +138,12 @@ SCHEMA = {
     "overlap_fraction_fp32": _is_frac,
     "overlap_fraction_bf16": _is_frac,
     "overlap_fraction_int8_blockscale": _is_frac,
+    # serving (apex_tpu.serve): the measured winner of the bench
+    # ``serve`` A/B leg — decode batch width and inference O-level
+    # (consumed by the serving harness as its defaults; the fp32
+    # numerics oracle stays reachable by explicit request)
+    "serve_decode_batch": _is_block,
+    "serve_olevel": lambda v: v in ("fp32", "bf16", "int8"),
 }
 
 

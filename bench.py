@@ -1559,6 +1559,99 @@ def bench_goodput(on_tpu, steps=10):
     return out
 
 
+def bench_serve(on_tpu, n_requests=None):
+    """Continuous-batching serving A/B (ISSUE 18, watcher stage 2i):
+    the same Poisson-arrival synthetic load — seeded, mixed prompt and
+    output lengths, mixed greedy/sampled — served by
+    ``apex_tpu.serve`` under each inference O-level x decode-width
+    variant, on one small flagship-shaped model.  Arrivals are modeled
+    in scheduler-step time (exponential inter-arrival, the classic
+    open-loop load), so every variant faces the identical request
+    trace.  Evidence per variant: tokens/sec, p50/p99 end-to-end
+    latency, TTFT, served/shed counts, and the FULL per-request
+    latency ledger snapshot (``telemetry.serve_ledger``) whose classes
+    partition every request's wall time exactly — audited by
+    ``apply_perf_results.serve_violations``; ``decide()`` persists the
+    winner as ``serve_decode_batch`` / ``serve_olevel``.  Compile is
+    warmed outside each variant's measured window (steady-state
+    serving numbers, not bring-up)."""
+    import numpy as np
+    from apex_tpu.models import TransformerConfig, transformer_init
+    from apex_tpu.serve import (CacheConfig, ContinuousBatcher,
+                                InferenceEngine, Request)
+
+    cfg = TransformerConfig(
+        vocab_size=211, max_len=64, num_layers=2, d_model=64, num_heads=4,
+        d_ff=128, causal=True, dtype=jnp.float32)
+    cache = CacheConfig(page_size=16, num_pages=32, max_ctx=64)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    n = n_requests or (32 if on_tpu else 16)
+
+    # the shared request trace: Poisson arrivals (exponential
+    # inter-arrival in scheduler steps), mixed lengths, mixed sampling
+    rng = np.random.RandomState(0)
+    arrivals = np.cumsum(rng.exponential(0.5, size=n)).astype(int)
+    specs = []
+    for i in range(n):
+        specs.append(dict(
+            rid=f"q{i}", prompt=rng.randint(1, cfg.vocab_size,
+                                            rng.randint(4, 25)).tolist(),
+            max_new_tokens=int(rng.randint(4, 17)),
+            temperature=0.8 if i % 2 else 0.0,
+            top_k=8 if i % 2 else 0, seed=i))
+
+    def _serve_trace(eng):
+        bat = ContinuousBatcher(eng)
+        i, step = 0, 0
+        while i < len(specs) or bat.queue or bat.active:
+            while i < len(specs) and arrivals[i] <= step:
+                bat.submit(Request(**specs[i]))
+                i += 1
+            bat.step()
+            step += 1
+        return bat
+
+    variants = [("bf16", 4), ("bf16", 8), ("fp32", 4), ("int8", 4)]
+    out = {"leg": "serve", "requests": n, "variants": []}
+    for olevel, width in variants:
+        _log(f"serve leg: {olevel} x width {width}: warm + {n} requests "
+             f"(Poisson arrivals) ...")
+        eng = InferenceEngine(params, cfg, cache=cache, olevel=olevel,
+                              decode_width=width)
+        warm = ContinuousBatcher(eng)          # compile outside the window
+        warm.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=2))
+        warm.run()
+        t0 = time.perf_counter()
+        bat = _serve_trace(eng)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        doc = bat.ledger.snapshot(olevel=olevel, decode_width=width,
+                                  compression_ratio=eng.compression_ratio)
+        rec = {"olevel": olevel, "decode_width": width,
+               "wall_ms": round(wall_ms, 3),
+               "tokens_per_sec": doc["tokens_per_sec"],
+               "p50_ms": doc["latency_ms"]["p50"],
+               "p99_ms": doc["latency_ms"]["p99"],
+               "ttft_p50_ms": doc["latency_ms"]["ttft_p50"],
+               "served": doc["requests"]["served"],
+               "shed": doc["requests"]["shed"],
+               "compression_ratio": doc.get("compression_ratio"),
+               "ledger": doc}
+        out["variants"].append(rec)
+        del eng, warm, bat
+        gc.collect()
+    win = max(out["variants"], key=lambda r: r["tokens_per_sec"] or 0.0)
+    out["winner"] = {"olevel": win["olevel"],
+                     "decode_width": win["decode_width"],
+                     "tokens_per_sec": win["tokens_per_sec"]}
+    gauges = {"serve.tokens_per_sec": win["tokens_per_sec"] or 0.0,
+              "serve.p50_ms": win["p50_ms"] or 0.0,
+              "serve.p99_ms": win["p99_ms"] or 0.0,
+              "serve.requests_served": win["served"],
+              "serve.requests_shed": win["shed"]}
+    out["telemetry"] = telemetry_summary([win["wall_ms"]], gauges=gauges)
+    return out
+
+
 def run_bench(budget_left=lambda: 1e9, legs_dir=None):
     """The bench with optional span tracing: ``APEX_BENCH_TRACE=<path>``
     wraps every leg in a span and writes the Chrome-trace timeline on
@@ -1791,6 +1884,20 @@ def _run_bench(budget_left=lambda: 1e9, legs_dir=None):
     else:
         _log("skipping goodput leg (budget)")
     gc.collect()
+    # continuous-batching serving A/B (ISSUE 18): O-level x decode-width
+    # variants over the same Poisson request trace; the embedded
+    # per-request ledgers feed the serve_violations audit and the
+    # serve_decode_batch / serve_olevel decisions
+    if budget_left() > 60:
+        try:
+            with _leg_span("serve"):
+                detail["serve"] = bench_serve(on_tpu)
+        except Exception as err:
+            detail["serve"] = {"error": repr(err)[:200]}
+        flush("serve", detail["serve"])
+    else:
+        _log("skipping serve leg (budget)")
+    gc.collect()
     # max-throughput BERT rung ladder (TPU only — the CPU stand-in says
     # nothing about the remat trade)
     if on_tpu and budget_left() > 120:
@@ -2021,6 +2128,19 @@ def _spmd_main():
                       "spmd": bench_spmd(on_tpu)}))
 
 
+def _serve_main():
+    """``python bench.py --serve``: ONLY the continuous-batching serving
+    A/B on the ambient backend, one JSON line — the leg tpu_watch.sh
+    runs as its own stage 2i (an O-level x decode-width A/B fits a
+    short tunnel window the full bench would waste)."""
+    from apex_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+    on_tpu = jax.default_backend() == "tpu"
+    print(json.dumps({"metric": "serve_ab",
+                      "backend": jax.default_backend(),
+                      "serve": bench_serve(on_tpu)}))
+
+
 def _ppep_main():
     """``python bench.py --ppep``: ONLY the pipeline/expert engine A/B
     on the ambient backend, one JSON line — the leg tpu_watch.sh runs
@@ -2049,6 +2169,8 @@ if __name__ == "__main__":
         _overlap_main()
     elif "--ppep" in sys.argv:
         _ppep_main()
+    elif "--serve" in sys.argv:
+        _serve_main()
     elif "--inner" in sys.argv:
         _inner_main(legs_dir=_argval(sys.argv, "--legs-dir"))
     else:

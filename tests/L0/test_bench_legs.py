@@ -401,6 +401,15 @@ def _stub_timings(bench, monkeypatch, wedge_at=None):
                            {"leg": "ppep", "parity_ok": True,
                             "families": {"pp": {"parity_ok": True},
                                          "ep": {"parity_ok": True}}}))
+    monkeypatch.setattr(bench, "bench_serve",
+                        mk("bench_serve",
+                           {"leg": "serve", "requests": 16,
+                            "variants": [{"olevel": "bf16",
+                                          "decode_width": 8,
+                                          "tokens_per_sec": 1500.0}],
+                            "winner": {"olevel": "bf16",
+                                       "decode_width": 8,
+                                       "tokens_per_sec": 1500.0}}))
     monkeypatch.setattr(bench, "bench_plan",
                         mk("bench_plan",
                            {"leg": "plan", "chips": 8,
@@ -449,8 +458,9 @@ def test_run_bench_full_flush_sequence(tmp_path, monkeypatch):
                 else "rn50_cpu_standin_resnet18")
     assert set(legs) == {"headline", rn50_key, "bert_e2e", "collectives",
                          "update_sharding", "plan", "spmd", "overlap",
-                         "ppep", "goodput"}
+                         "ppep", "goodput", "serve"}
     assert legs["ppep"]["data"]["leg"] == "ppep"
+    assert legs["serve"]["data"]["leg"] == "serve"
     assert legs["collectives"]["data"]["leg"] == "collectives"
     assert legs["goodput"]["data"]["leg"] == "goodput"
     assert legs["overlap"]["data"]["leg"] == "overlap"

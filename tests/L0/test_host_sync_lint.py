@@ -29,6 +29,12 @@ inherently host-side boundary):
   * ``checkpoint.py``          — serialization is a host operation
   * ``interop/__init__.py``    — the torch bridge is host-side by design
   * ``pyprof/prof.py``         — measured timing must synchronize
+  * ``serve/schedule.py``      — the continuous-batching scheduler's
+    single per-decode-step boundary read (ISSUE 18): ONE batched
+    ``device_get`` of the decode tokens + pending prefill tokens per
+    step; all page-table, position, and admission bookkeeping is host
+    arithmetic, so the step count — not the request count — bounds the
+    syncs
 
 A second, narrower budget covers ``device.memory_stats()`` (ISSUE 6):
 allocator polling is a host read too, and it must stay batched at the
@@ -57,6 +63,7 @@ SANCTIONED = {
     "checkpoint.py",
     os.path.join("interop", "__init__.py"),
     os.path.join("pyprof", "prof.py"),
+    os.path.join("serve", "schedule.py"),
 }
 
 #: allocator polling is its own, narrower budget: memory_stats() calls

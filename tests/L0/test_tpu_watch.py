@@ -53,6 +53,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_OVERLAP_CMD": "",
            # and the pipeline/expert engine A/B (stage 2h)
            "APEX_WATCH_PPEP_CMD": "",
+           # and the continuous-batching serving A/B (stage 2i)
+           "APEX_WATCH_SERVE_CMD": "",
            # and the elastic kill-N-resume-M proof (stage 3b)
            "APEX_WATCH_ELASTIC_CMD": "",
            # and its real-data twin (stage 3b-real)
@@ -649,6 +651,51 @@ def test_ppep_ab_stage_artifact_and_span(tmp_path):
     assert "ppep_ab A/B done rc=1" in log3
     assert not (tmp_path / "PPEP_FAIL.json").exists()
     assert not (tmp_path / "PPEP_FAIL.json.run").exists()
+
+
+def test_serve_ab_stage_artifact_and_span(tmp_path):
+    """ISSUE 18 satellite: the continuous-batching serving A/B runs as
+    watch stage 2i — artifact written atomically, span appended to the
+    streaming timeline, skip-when-complete, and a failing leg leaves no
+    truncated artifact behind (mirror of stages 2b-2h)."""
+    fake = json.dumps({"metric": "serve_ab", "backend": "tpu",
+                       "serve": {"leg": "serve", "variants": []}})
+    marker = tmp_path / "serve_calls"
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    }
+    r, log = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_SERVE_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    art = json.loads((tmp_path / "SERVE_AB_r5.json").read_text())
+    assert art["serve"]["leg"] == "serve"
+    assert "serve_ab A/B done rc=0" in log
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.serve_ab" in names
+    # second window: artifact present -> stage skipped
+    r2, _ = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_SERVE_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+
+    # a failing A/B leaves no truncated artifact behind
+    r3, log3 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_SERVE_JSON": "SERVE_FAIL.json",
+        "APEX_WATCH_SERVE_CMD": "echo '{\"partial\":true'; false",
+    })
+    assert r3.returncode == 0
+    assert "serve_ab A/B done rc=1" in log3
+    assert not (tmp_path / "SERVE_FAIL.json").exists()
+    assert not (tmp_path / "SERVE_FAIL.json.run").exists()
 
 
 def _write_spmd_capture(tmp_path, dirname="SPMD_PROFILE_r5"):

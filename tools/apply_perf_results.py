@@ -180,6 +180,85 @@ def goodput_violations(artifact) -> list:
     return out
 
 
+def _serve_schema():
+    """The committed serve-ledger schema
+    (apex_tpu/telemetry/serve_ledger.py), loaded file-based like
+    :func:`_goodput_schema` so the CLI never pays the jax import (the
+    serve-ledger module keeps jax out of module scope for exactly
+    this)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_apex_tpu_telemetry_serve_ledger",
+        os.path.join(REPO, "apex_tpu", "telemetry", "serve_ledger.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def serve_violations(artifact) -> list:
+    """Audit for the continuous-batching serving leg (ISSUE 18): every
+    embedded serve-ledger doc (``kind: "serve_ledger"`` — the bench
+    leg's per-variant ledgers and a scheduler-written ``SERVE.json``
+    both carry it) must satisfy the committed ledger schema, whose
+    load-bearing checks are that the ledger classes PARTITION every
+    request's wall time EXACTLY (integer microseconds, tolerance
+    zero), p99 is present when anything was served, shed requests are
+    metered in the ``shed`` class, and an int8 O-level carries its
+    metered compression ratio.  The leg-level winner must point at a
+    measured variant.  Warnings only, same posture as the other
+    audits."""
+    out = []
+    schema = None   # loaded once, and only if a serve doc exists
+
+    def walk(node, path):
+        nonlocal schema
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+            return
+        if not isinstance(node, dict):
+            return
+        if node.get("kind") == "serve_ledger":
+            if schema is None:
+                schema = _serve_schema()
+            out.extend(f"{path}: {v}"
+                       for v in schema.serve_violations(node))
+            return   # a ledger doc has no nested ledgers
+        if node.get("leg") == "serve" and "error" not in node:
+            variants = node.get("variants")
+            if not isinstance(variants, list) or not variants:
+                out.append(f"{path}: serve leg carries no variants")
+            else:
+                for i, v in enumerate(variants):
+                    if not isinstance(v.get("ledger"), dict):
+                        out.append(f"{path}.variants[{i}]: no embedded "
+                                   f"serve ledger")
+                    if v.get("p99_ms") is None:
+                        out.append(f"{path}.variants[{i}]: p99 missing")
+                    if v.get("olevel") == "int8" and not (
+                            isinstance(v.get("compression_ratio"),
+                                       (int, float))
+                            and v["compression_ratio"] > 1.0):
+                        out.append(
+                            f"{path}.variants[{i}]: int8 variant "
+                            f"without a metered compression ratio > 1")
+            win = node.get("winner")
+            if isinstance(variants, list) and variants:
+                keys = {(v.get("olevel"), v.get("decode_width"))
+                        for v in variants}
+                if not isinstance(win, dict) or (
+                        win.get("olevel"),
+                        win.get("decode_width")) not in keys:
+                    out.append(f"{path}: winner is not a measured "
+                               f"variant")
+        for k, v in node.items():
+            if k != "telemetry":
+                walk(v, f"{path}.{k}")
+
+    walk(artifact if isinstance(artifact, dict) else {}, "artifact")
+    return out
+
+
 def telemetry_violations(artifact) -> list:
     """Schema complaints for every ``telemetry`` block embedded in a
     bench artifact (``{"records": [...], "summary": {...}}`` blocks, as
@@ -970,6 +1049,38 @@ def decide(bench, kern):
                         f"{pl.get('feasible')} feasible plans; "
                         f"calibration error {err}%"))
 
+        sv = det.get("serve")
+        if isinstance(sv, dict) and sv.get("_backend") in (None, "tpu") \
+                and isinstance(sv.get("variants"), list) \
+                and isinstance(sv.get("winner"), dict) \
+                and "error" not in sv \
+                and not serve_violations({"serve": sv}):
+            # serve_decode_batch / serve_olevel <- the serving A/B's
+            # measured tokens/sec winner, but only from a clean audit
+            # (every variant's per-request ledger partitioned exactly,
+            # p99 present, int8 compression metered) and only when the
+            # winner actually served its load without shedding — a
+            # variant that won by shedding work isn't a winner
+            win = sv["winner"]
+            wrow = next((v for v in sv["variants"]
+                         if v.get("olevel") == win.get("olevel")
+                         and v.get("decode_width")
+                         == win.get("decode_width")), None)
+            if wrow and isinstance(wrow.get("tokens_per_sec"),
+                                   (int, float)) \
+                    and wrow["tokens_per_sec"] > 0 \
+                    and not wrow.get("shed"):
+                prof["serve_decode_batch"] = int(wrow["decode_width"])
+                prof["serve_olevel"] = str(wrow["olevel"])
+                rows.append((
+                    "serve_decode_batch / serve_olevel",
+                    f"{prof['serve_decode_batch']} / "
+                    f"{prof['serve_olevel']}",
+                    f"serving A/B over {len(sv['variants'])} variants: "
+                    f"winner {wrow['tokens_per_sec']} tok/s, p99 "
+                    f"{wrow.get('p99_ms')} ms, served "
+                    f"{wrow.get('served')} shed {wrow.get('shed')}"))
+
     return prof, rows
 
 
@@ -1032,6 +1143,11 @@ def main(argv=None):
             # and every embedded goodput ledger (classes must partition
             # the wall exactly; replay badput iff rollbacks metered)
             for v in goodput_violations(art):
+                print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
+            # and the serving A/B leg (per-request ledger classes must
+            # partition each request's wall exactly; p99 present; int8
+            # carries its metered compression ratio)
+            for v in serve_violations(art):
                 print(f"[apply_perf] WARNING {label} {v}", file=sys.stderr)
 
     prof, rows = decide(bench, kern)

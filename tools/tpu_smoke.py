@@ -238,6 +238,49 @@ def check_multi_tensor(tiny):
     return max(errs)
 
 
+def check_serve_compile(tiny):
+    """Serving-engine compile smoke (ISSUE 18): every inference O-level
+    (fp32 / bf16 / int8 block-scaled weights) builds an
+    ``InferenceEngine`` over the paged KV cache and runs one prefill +
+    one batched decode step on a tiny config.  Value is the count of
+    O-levels that failed to build/run or produced a non-finite /
+    out-of-range token (0.0 = all compiled); a toolchain where the
+    serving engine cannot compile must fail the smoke before a serve
+    A/B window is spent measuring it.  Tiny and production variants run
+    the same logic — the cost is compile time, not shape-dependent
+    numerics."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu.models import TransformerConfig, transformer_init
+    from apex_tpu.serve import (CacheConfig, InferenceEngine, OLEVELS,
+                                Request, ContinuousBatcher)
+
+    cfg = TransformerConfig(vocab_size=64, max_len=32, num_layers=2,
+                            d_model=32, num_heads=2, d_ff=64,
+                            causal=True, xent_impl="xla")
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    cache = CacheConfig(page_size=8, num_pages=16, max_ctx=32)
+    failed = 0
+    for olevel in OLEVELS:
+        try:
+            eng = InferenceEngine(params, cfg, cache=cache,
+                                  olevel=olevel, decode_width=2)
+            bat = ContinuousBatcher(eng)
+            bat.submit(Request(rid=f"smoke-{olevel}",
+                               prompt=(1, 2, 3, 4), max_new_tokens=2))
+            bat.run(max_steps=16)
+            res = bat.results[f"smoke-{olevel}"]
+            toks = np.asarray(res.tokens)
+            if (res.status != "done" or len(res.tokens) != 2
+                    or not bool(jnp.all((toks >= 0)
+                                        & (toks < cfg.vocab_size)))):
+                failed += 1
+        except Exception:
+            failed += 1
+    return float(failed)
+
+
 # check name -> (fn, relative-error tolerance).  bf16 kernels compare
 # bf16-vs-bf16 math but accumulate differently (blocked f32 partials vs
 # one einsum), hence the looser flash tolerances.
@@ -255,6 +298,9 @@ CHECKS = {
     # families that failed to compile+run a tiny step — 0 required
     # (tol 0.5 admits only the zero count)
     "spmd_compile": (check_spmd_compile, 0.5),
+    # not a numerics check: the value is the count of serving O-levels
+    # whose engine failed to compile+run prefill/decode — 0 required
+    "serve_compile": (check_serve_compile, 0.5),
 }
 
 

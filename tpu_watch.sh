@@ -173,6 +173,15 @@ OVERLAP_TO=${APEX_WATCH_OVERLAP_TO:-400}
 PPEP_CMD=${APEX_WATCH_PPEP_CMD-"python bench.py --ppep"}
 PPEP_JSON=${APEX_WATCH_PPEP_JSON:-PPEP_AB_r5.json}
 PPEP_TO=${APEX_WATCH_PPEP_TO:-400}
+# stage 2i: continuous-batching serving A/B (ISSUE 18) — the
+# apex_tpu.serve engine over a Poisson request trace, inference
+# O-level x decode-width variants with per-request latency ledgers;
+# feeds apply_perf_results' serve_violations audit and the
+# serve_decode_batch / serve_olevel decisions.
+# ${VAR-default}: an explicitly EMPTY override disables the stage
+SERVE_CMD=${APEX_WATCH_SERVE_CMD-"python bench.py --serve"}
+SERVE_JSON=${APEX_WATCH_SERVE_JSON:-SERVE_AB_r5.json}
+SERVE_TO=${APEX_WATCH_SERVE_TO:-400}
 # stage 4b: bench-trend / goodput regression watchdog (ISSUE 15) —
 # ingest the committed BENCH_r*/BENCH_TPU_r* trajectory plus any
 # GOODPUT*.json run ledgers and flag per-leg step-time/MFU/goodput
@@ -421,6 +430,21 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$PPEP_JSON".run
       fi
       echo "$(date +%H:%M:%S) ppep_ab A/B done rc=$rcpp" >> "$LOG"
+    fi
+    # ---- stage 2i: continuous-batching serving A/B (best-effort) ----
+    if [ -n "$SERVE_CMD" ] && [ ! -s "$SERVE_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$SERVE_TO" bash -c "$SERVE_CMD" > "$SERVE_JSON".run 2>> "$LOG"
+      rcsv=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span serve_ab "$t0" "$rcsv"
+      stage_mem
+      if [ $rcsv -eq 0 ] && [ -s "$SERVE_JSON".run ]; then
+        mv "$SERVE_JSON".run "$SERVE_JSON"
+      else
+        # a wedged/failed A/B never leaves a truncated artifact behind
+        rm -f "$SERVE_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) serve_ab A/B done rc=$rcsv" >> "$LOG"
     fi
     # ---- stage 3a: guard-driven resumable train (incremental) ----
     # BEFORE the all-or-nothing save/resume leg: the guard leg makes
