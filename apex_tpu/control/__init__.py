@@ -1,0 +1,43 @@
+"""``apex_tpu.control`` — the self-driving run controller.
+
+Every signal (goodput fractions, straggler z-scores, exposed-comm
+fraction) and every actuator (the per-bucket collective-scheme
+registry, ``plan.search``, the elastic ``resize@N:M`` reshard) already
+exists in the repo; this package closes the loop at runtime.  A
+:class:`RunController` rides TrainGuard's batched health-check window
+— no new host syncs, it consumes the same once-per-``check_every``
+``device_get`` the guard already pays for — evaluates declarative
+:class:`~apex_tpu.control.policy.Policy` bands over the live signals,
+and fires bounded, hysteresis-gated actions: a live collective-wire
+retune, a mid-run replan+reshard, or a straggler quarantine through
+the elastic resize path.  Every decision is a ``control.*`` event and
+a row in the schema-validated ``CONTROL.json`` ledger; action failures
+degrade to the pre-action config, never crash the run; and
+``APEX_TPU_CONTROL=0`` (or no controller) is a true no-op —
+bitwise-identical run, zero controller host syncs, asserted by
+``tests/L0/test_control.py``.
+
+See docs/control.md for the policy table, the signal->action matrix,
+the safety bounds, and when to keep the controller OFF.
+"""
+from .controller import (ControlActionError, ControlConfig,
+                         DEFAULT_ACTUATORS, META_CONTROL_KEY,
+                         RETUNE_LADDER, RunController, act_comm_retune,
+                         act_quarantine, act_replan_reshard)
+from .ledger import (ARTIFACT_NAME, OUTCOMES, build_doc,
+                     control_violations, format_control, load_artifact,
+                     write_doc)
+from .policy import (Band, Policy, PolicyState,
+                     DEFAULT_EXPOSED_COMM_CEILING, DEFAULT_GOODPUT_FLOOR,
+                     DEFAULT_STRAGGLER_WINDOWS, default_policies)
+
+__all__ = [
+    "ControlActionError", "ControlConfig", "RunController",
+    "DEFAULT_ACTUATORS", "META_CONTROL_KEY", "RETUNE_LADDER",
+    "act_comm_retune", "act_quarantine", "act_replan_reshard",
+    "ARTIFACT_NAME", "OUTCOMES", "build_doc", "control_violations",
+    "format_control", "load_artifact", "write_doc",
+    "Band", "Policy", "PolicyState", "default_policies",
+    "DEFAULT_EXPOSED_COMM_CEILING", "DEFAULT_GOODPUT_FLOOR",
+    "DEFAULT_STRAGGLER_WINDOWS",
+]

@@ -313,7 +313,14 @@ def replan(chips: int, *, profile=None, saved_knobs: Optional[dict] = None,
     flagship step (an AOT compile — pass a profile on hot paths).
     Returns the ranked winner (None when nothing is feasible) and emits
     one ``elastic.replan`` event carrying the old knobs (when known)
-    and the new winner's."""
+    and the new winner's.
+
+    Callers: the elastic resume path (the pool changed across a
+    restart) and the run controller's mid-run ``replan_reshard``
+    actuator (``apex_tpu.control`` — the pool didn't change but the
+    measured goodput regime did; same search, same ``elastic.replan``
+    span, so the goodput ledger meters the mid-run search as
+    ``reshard`` badput)."""
     from ..telemetry import trace as _trace
     emit = emit or _emit_default
     if profile is None:

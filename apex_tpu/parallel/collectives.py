@@ -156,6 +156,37 @@ def available() -> Tuple[str, ...]:
 
 _OPT = re.compile(r"^(block|min_bytes)=(\d+)$")
 
+# -- live override (apex_tpu.control comm retune) ---------------------------
+# The run controller's actuation surface: a process-wide spec that
+# :func:`resolve` consults for DEFAULT resolutions (scheme=None) ahead
+# of the APEX_TPU_COLLECTIVES env and the tuning profile.  Explicitly
+# passed schemes still win — a caller that pinned a wire stays pinned.
+# Takes effect at the next engine build (resolve time): overlap.Reducer
+# / spmd.build_plan_step re-resolve when (re)constructed, which is
+# exactly when an elastic resume or a fresh jit brings the run back up.
+
+_LIVE_SPEC: Optional[CollectiveSpec] = None
+
+
+def set_live_spec(spec) -> Optional[CollectiveSpec]:
+    """Install ``spec`` (a :class:`CollectiveSpec`, a spec string, a
+    bare scheme name, or None to clear) as the live override.  Returns
+    the previous override so actuators can revert on failure."""
+    global _LIVE_SPEC
+    prev = _LIVE_SPEC
+    if spec is None:
+        _LIVE_SPEC = None
+    elif isinstance(spec, CollectiveSpec):
+        get_scheme(spec.scheme)   # validate before anything resolves it
+        _LIVE_SPEC = spec
+    else:
+        _LIVE_SPEC = parse_spec(str(spec))
+    return prev
+
+
+def get_live_spec() -> Optional[CollectiveSpec]:
+    return _LIVE_SPEC
+
 
 def parse_spec(text: str) -> CollectiveSpec:
     """``"int8_blockscale:block=128,min_bytes=4096"`` ->
@@ -184,7 +215,8 @@ def resolve(scheme=None, *, min_bytes: Optional[int] = None,
     """Resolve a scheme choice to a spec (or None = legacy psum).
 
     Precedence: explicit ``scheme`` (name / spec string /
-    :class:`CollectiveSpec`) > ``APEX_TPU_COLLECTIVES`` env > the
+    :class:`CollectiveSpec`) > the controller's live override
+    (:func:`set_live_spec`) > ``APEX_TPU_COLLECTIVES`` env > the
     measured tuning profile under ``tuning_key`` (TPU only; pass
     ``tuning_key=None`` to opt out — the ZeRO paths do, their knob is
     the constructor argument) > None.  ``min_bytes``/``block`` override
@@ -192,6 +224,13 @@ def resolve(scheme=None, *, min_bytes: Optional[int] = None,
     """
     spec: Optional[CollectiveSpec] = None
     if scheme is None:
+        if _LIVE_SPEC is not None:
+            spec = _LIVE_SPEC
+            if min_bytes is not None:
+                spec = dataclasses.replace(spec, min_bytes=int(min_bytes))
+            if block is not None:
+                spec = dataclasses.replace(spec, block=int(block))
+            return spec
         env = os.environ.get(ENV_KNOB)
         if env is not None and env.strip().lower() in _ENV_OFF:
             return None

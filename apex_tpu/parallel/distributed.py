@@ -156,6 +156,10 @@ def allreduce_tree(grads, *, axis_name: str = DATA_AXIS,
 
     per_leaf = callable(scheme)
     leaves, paths, treedef = _leaf_paths(grads, per_leaf)
+    # resolve() consults the run controller's live override for
+    # scheme=None defaults (collectives.set_live_spec — the comm-retune
+    # actuator), so a retuned wire takes effect here at the next traced
+    # build without touching any caller
     if per_leaf:
         specs = [_coll.resolve(s, min_bytes=min_compress_bytes)
                  if (s := scheme(p, l)) is not None else None

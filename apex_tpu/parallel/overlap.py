@@ -279,6 +279,9 @@ def bucketed_allreduce(grads, *, axis_name: str = DATA_AXIS,
         raise ValueError(
             "bucketed_allreduce cannot stream a callable per-leaf scheme; "
             "gate on can_stream() and use the deferred allreduce_tree")
+    # a scheme=None default consults the controller's live override
+    # (collectives.set_live_spec) ahead of env/tuning — the comm-retune
+    # actuator's surface; effective at the next traced build
     spec = _coll.resolve(scheme, min_bytes=min_compress_bytes)
     if spec is not None and _coll.get_scheme(spec.scheme).self_scaling:
         raise ValueError(

@@ -925,7 +925,13 @@ def search(profile: ModelProfile, chips: int, *,
     everything whose per-replica HBM exceeds the capacity (the
     ceilings' ``hbm_bytes`` unless ``capacity_bytes`` overrides), rank
     by predicted step time with near-ties broken toward the simpler
-    plan.  Never returns an HBM-infeasible plan (property-tested)."""
+    plan.  Never returns an HBM-infeasible plan (property-tested).
+
+    Invoked between runs (bench/tuning, elastic resume at a new chip
+    count) and MID-RUN by the controller's ``replan_reshard`` actuator
+    (``apex_tpu.control`` via :func:`apex_tpu.elastic.replan`) — the
+    search is pure host arithmetic over the cost model, so an in-run
+    call costs milliseconds, no compiles, no device syncs."""
     ceil = dict(_resolve_ceil(ceilings, platform or profile.platform))
     if capacity_bytes is not None:
         ceil["hbm_bytes"] = float(capacity_bytes)

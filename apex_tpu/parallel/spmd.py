@@ -277,7 +277,15 @@ def build_plan_step(cfg, mesh, plan, *, global_batch: int, lr: float = 1e-2,
     ``Plan.apply()`` builds); knobs without an engine argument resolve
     through their existing env surfaces, which ``Plan.apply()`` sets.
     ``amp_dtype="bfloat16"`` selects the O2-style bf16 model copy over
-    the fp32 master (fused-flat engines only)."""
+    the fp32 master (fused-flat engines only).
+
+    Rebuild semantics: collective-scheme defaults re-resolve at build
+    time (``collectives.resolve`` — which consults the controller's
+    live override first), so a mid-run ``comm_retune`` or
+    ``replan_reshard`` decision (``apex_tpu.control``) lands the next
+    time an engine is (re)built — an elastic resume, a fresh jit after
+    preempt, or an explicit rebuild; in-flight compiled executables
+    keep their traced wire, by design."""
     from .plan import Plan  # noqa: F401  (typing/doc aid; no cycle at import)
     family = plan.family
     if plan.zero:

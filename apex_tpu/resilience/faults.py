@@ -16,7 +16,7 @@ Spec grammar (config string or the ``APEX_TPU_FAULTS`` env var)::
     entry      := KIND@STEP [ xCOUNT ] [ :ARG ] | seed=N
     KIND       := nan | inf | preempt | loader_stall | collective_fail
                   | oom | resize | shard_corrupt | index_missing
-                  | request_flood
+                  | request_flood | straggler | goodput_degrade
                   (aliases: nan_grads -> nan, inf_grads -> inf,
                    sigterm -> preempt)
     STEP       := first step (0-based) the fault is armed at
@@ -27,6 +27,10 @@ Spec grammar (config string or the ``APEX_TPU_FAULTS`` env var)::
                   resize: REQUIRED target world size, e.g. resize@40:4;
                   request_flood: REQUIRED burst size K,
                   e.g. request_flood@8:16;
+                  straggler: REQUIRED slowdown factor F > 1,
+                  e.g. straggler@4x12:3;
+                  goodput_degrade: REQUIRED badput seconds per armed
+                  step F > 0, e.g. goodput_degrade@4x8:0.05;
                   shard_corrupt: byte offset to flip, default mid-file)
 
 Fault kinds and their consumers:
@@ -84,6 +88,23 @@ Fault kinds and their consumers:
     never a silent drop; the serve ledger meters the shed time in its
     ``shed`` class.  ``K`` is required and must be a positive integer,
     like ``resize``'s target.
+  * ``straggler`` — ``straggler@N:F`` makes ONE device persistently
+    slow by factor ``F`` for the armed steps: the guard injects a
+    proportional delay inside the scheduled step's ``train.step`` span
+    (:func:`straggler_delay`) and attributes the slowdown to a single
+    deterministic device (``plan.seed % world``) in the per-device busy
+    rows it feeds the run controller — so the leave-one-out z-score
+    (``telemetry.timeline.straggler_rows``) names the same device
+    window after window and ``apex_tpu.control``'s quarantine policy
+    resizes around it.  ``F`` is required and must be > 1 (a
+    "straggler" that isn't slower is a spec bug).
+  * ``goodput_degrade`` — ``goodput_degrade@N:F`` injects ``F`` seconds
+    of sustained synthetic badput per armed step: the guard sleeps
+    OUTSIDE any span, so the goodput ledger's exact partition
+    attributes the loss to its ``idle`` class and the run's windowed
+    ``goodput_fraction`` sinks below the controller's floor — the
+    trigger for the mid-run replan+reshard policy.  ``F`` is required
+    and must be > 0.
 
 Every kind above also declares the goodput-ledger badput class its
 injection is expected to land in (``telemetry.goodput.FAULT_BADPUT``;
@@ -103,7 +124,8 @@ import time
 from typing import List, Optional, Tuple
 
 KINDS = ("nan", "inf", "preempt", "loader_stall", "collective_fail", "oom",
-         "resize", "shard_corrupt", "index_missing", "request_flood")
+         "resize", "shard_corrupt", "index_missing", "request_flood",
+         "straggler", "goodput_degrade")
 _ALIASES = {"nan_grads": "nan", "inf_grads": "inf", "sigterm": "preempt"}
 
 _ENTRY = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
@@ -225,6 +247,14 @@ def parse(spec: str) -> FaultPlan:
             raise FaultError(
                 f"request_flood needs a positive integer burst size: "
                 f"request_flood@STEP:K (got {entry!r})")
+        if kind == "straggler" and arg <= 1:
+            raise FaultError(
+                f"straggler needs a slowdown factor > 1: "
+                f"straggler@STEP:F (got {entry!r})")
+        if kind == "goodput_degrade" and arg <= 0:
+            raise FaultError(
+                f"goodput_degrade needs badput seconds > 0: "
+                f"goodput_degrade@STEP:F (got {entry!r})")
         specs.append(FaultSpec(
             kind=kind, step=int(m.group("step")),
             count=int(m.group("count") or 1), arg=arg))
@@ -319,6 +349,26 @@ class StallingIterator:
             maybe_stall(self._step, plan=self._plan)
             self._step += 1
             yield item
+
+
+#: nominal per-step base the injected straggler slowdown scales from —
+#: small enough that a chaos run with dozens of armed steps stays in
+#: tier-1's budget, large enough to dominate host timing noise
+STRAGGLER_BASE_S = 0.002
+#: hard cap on any single injected straggler delay (a wild F in a spec
+#: must not turn a chaos test into a hang)
+STRAGGLER_CAP_S = 0.05
+
+
+def straggler_delay(arg: float, *, base_s: float = STRAGGLER_BASE_S,
+                    cap_s: float = STRAGGLER_CAP_S) -> float:
+    """Seconds of extra in-step delay a ``straggler@N:F`` injection
+    adds: ``base * (F - 1)``, capped.  The guard sleeps this inside the
+    ``train.step`` span (the slowdown is real step time, not badput)
+    and reports the factor ``F`` itself in the per-device busy rows —
+    the delay makes the wall-clock honest, the rows make the
+    leave-one-out z-score deterministic."""
+    return min(cap_s, base_s * max(0.0, float(arg) - 1.0))
 
 
 def wrap_collective(fn, *, plan: Optional[FaultPlan] = None,

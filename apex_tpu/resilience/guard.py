@@ -176,6 +176,11 @@ class GuardReport:
     #: was active (the ledger streams off the default tracer's spans)
     goodput: Optional[dict] = None
     goodput_path: Optional[str] = None
+    #: the run controller's decision-ledger doc (``apex_tpu.control``)
+    #: and the ``CONTROL.json`` path it was written to — None when no
+    #: enabled controller rode the run
+    control: Optional[dict] = None
+    control_path: Optional[str] = None
 
 
 def _observed_save(manager: CheckpointManager, step: int, payload,
@@ -284,12 +289,17 @@ class TrainGuard:
     ``on_check(step, losses)`` is called with the
     resolved loss window at every health check (the example loops' print
     hook — the values are already host floats, printing costs nothing
-    extra)."""
+    extra); ``controller`` pins an
+    :class:`apex_tpu.control.RunController` that rides the same batched
+    health-check window (``controller.on_window`` right after every
+    batched read — the controller adds ZERO host syncs of its own, and
+    a disabled/absent controller leaves the loop bitwise-untouched)."""
 
     def __init__(self, step_fn: Callable, config: GuardConfig, *,
                  plan=None, registry=None, scaler_fn=None, elastic=None,
                  on_check: Optional[Callable[[int, List[float]],
-                                             None]] = None):
+                                             None]] = None,
+                 controller=None):
         self.step_fn = step_fn
         self.cfg = config
         self._plan = plan
@@ -297,6 +307,7 @@ class TrainGuard:
         self._scaler_fn = scaler_fn
         self._elastic = elastic
         self._on_check = on_check
+        self._controller = controller
         self._stop = False
         self.manager = (CheckpointManager(config.ckpt_dir,
                                           keep_last=config.keep_last)
@@ -413,6 +424,44 @@ class TrainGuard:
                                                    doc=doc)
         except Exception:   # disk full / off-schema doc: the run's
             pass            # outcome must still propagate untouched
+
+    def _finalize_control(self, ctl, tracer, report) -> None:
+        """Close out the run controller's decision ledger (best-effort,
+        like :meth:`_finalize_goodput`): snapshot the ``CONTROL.json``
+        doc with the run's final status and write it on the same
+        flight-recorder destination chain — exit, preempt and crash
+        all leave the audit trail."""
+        try:
+            doc = ctl.snapshot(status=report.status)
+            report.control = doc
+            directory = self._flight_destination(
+                tracer.recorder.directory
+                if tracer is not None and tracer.enabled else None)
+            if directory is not None:
+                report.control_path = ctl.write(directory=directory,
+                                                doc=doc)
+        except Exception:   # the audit artifact must never mask the
+            pass            # run's real outcome
+
+    # -- controller actuation ------------------------------------------------
+    def request_resize(self, target_world: int, *, step=None,
+                       reason: str = "control") -> None:
+        """A synthesized ``resize@N:M``: the run controller's
+        quarantine actuator calls this from INSIDE the health-check
+        boundary, so unlike the injected fault no signal is needed —
+        record the target world in the report and flip the stop flag;
+        the loop's existing preempt machinery does the
+        snapshot-then-clean-exit, and the harness brings the run back
+        up at ``target_world`` through the elastic reshard, exactly
+        like a fleet resize."""
+        rep = getattr(self, "_report", None)
+        if rep is None:
+            raise RuntimeError("request_resize outside an active "
+                               "guarded run")
+        rep.resize_to = int(target_world)
+        self._emit("control.resize_requested", step=step,
+                   target_world=int(target_world), reason=str(reason))
+        self._stop = True
 
     # -- state <-> host ------------------------------------------------------
     def _snapshot(self, state, step: int) -> dict:
@@ -614,8 +663,15 @@ class TrainGuard:
         plan = self._plan if self._plan is not None else _faults.active_plan()
         it = None if seekable else iter(batches)
         report = GuardReport(status="completed", final_step=start_step)
+        self._report = report   # request_resize targets the live run
         mgr = self.manager
         step = start_step
+        # the run controller rides the health-check window below; a
+        # disabled controller (APEX_TPU_CONTROL=0) is dropped HERE so
+        # every touch point in the loop is skipped — the no-op contract
+        ctl = self._controller
+        if ctl is not None and not getattr(ctl, "enabled", False):
+            ctl = None
 
         from ..telemetry import events as _tel_events
         from ..telemetry import goodput as _goodput
@@ -644,6 +700,7 @@ class TrainGuard:
         self._streak = 0
         self._floor_checks = 0
         self._last_bad_step: Optional[int] = None
+        self._last_losses: List[float] = []
         # the run-level goodput ledger (docs/telemetry.md Goodput
         # ledger): one per run, streaming off the default tracer's
         # spans/events, installed as the process ledger so every
@@ -664,10 +721,12 @@ class TrainGuard:
             ledger.attach(tracer)
             prev_ledger = _goodput.install(ledger)
         try:
+            resumed_meta = None
             if mgr is not None and cfg.auto_resume:
                 found = mgr.load_latest(with_meta=True)
                 if found is not None and found[0] > start_step:
                     ck_step, payload, saved_meta = found
+                    resumed_meta = saved_meta
                     # the data stream must be the SAME one the manifest
                     # cursor names — seeking a changed dataset would
                     # silently void the bitwise replay guarantee
@@ -690,6 +749,12 @@ class TrainGuard:
                         # re-firing preempt would wedge the run in a
                         # preempt/resume loop)
                         plan.skip_until(step)
+            if ctl is not None:
+                # attach AFTER the resume so an acted config recorded
+                # in the interrupted run's manifest meta (a mid-action
+                # preempt) is re-applied before any step runs
+                ctl.arm(guard=self, manager=mgr, live_world=live_world,
+                        saved_meta=resumed_meta)
             last_saved = step
             t_last_save = time.monotonic()
             if mgr is not None and step < num_steps:
@@ -722,6 +787,24 @@ class TrainGuard:
                     signal.raise_signal(signal.SIGTERM)
                 if self._stop:
                     break
+                if plan is not None:
+                    spec = plan.fire("goodput_degrade", step)
+                    if spec is not None:
+                        # sustained synthetic badput: sleep OUTSIDE any
+                        # span, so the goodput ledger's exact partition
+                        # attributes it to idle and the controller's
+                        # windowed goodput_fraction sinks — the
+                        # replan-policy chaos trigger
+                        report.faults_injected += 1
+                        self._emit("fault_injected", kind="goodput_degrade",
+                                   step=step, seconds=float(spec.arg))
+                        time.sleep(float(spec.arg))
+                straggler_spec = (plan.fire("straggler", step)
+                                  if plan is not None else None)
+                if straggler_spec is not None:
+                    report.faults_injected += 1
+                    self._emit("fault_injected", kind="straggler",
+                               step=step, factor=float(straggler_spec.arg))
                 if plan is not None and plan.fire("oom", step) is not None:
                     # deterministic allocator exhaustion: the raise
                     # rides the normal exception path below, which
@@ -749,8 +832,31 @@ class TrainGuard:
                 # (Registry.step() emits the same name for loops it
                 # wraps — the ledger unions overlaps, never counts
                 # the same wall-clock twice)
+                t_step = time.perf_counter() if ctl is not None else 0.0
                 with _trace.span("train.step", step=step):
+                    if straggler_spec is not None:
+                        # the injected slowdown is real (slower) step
+                        # time, inside the span — a straggler costs
+                        # productive seconds, not badput
+                        time.sleep(_faults.straggler_delay(
+                            straggler_spec.arg))
                     state, loss = split(self.step_fn(state, batch))
+                if ctl is not None and live_world and int(live_world) >= 2:
+                    # per-device busy rows for the controller's leave-
+                    # one-out straggler naming: host step timing spread
+                    # over the emulated mesh, with the armed straggler
+                    # fault's factor attributed to one deterministic
+                    # device (plan.seed % world — on silicon,
+                    # timeline.decompose rows replace this synthesis)
+                    busy_ms = (time.perf_counter() - t_step) * 1e3
+                    devs = {f"d{i}": busy_ms
+                            for i in range(int(live_world))}
+                    if straggler_spec is not None:
+                        culprit = ((plan.seed if plan is not None else 0)
+                                   % int(live_world))
+                        devs[f"d{culprit}"] = busy_ms * max(
+                            float(straggler_spec.arg), 1.0)
+                    ctl.feed_device_stats(step, devs)
                 if loss is not None:
                     pending.append((step, loss))
                 step += 1
@@ -762,6 +868,17 @@ class TrainGuard:
                     healthy = self._health_check(state, pending)
                 pending.clear()             # window consumed either way
                 since_check = 0
+                if healthy and ctl is not None and not self._stop:
+                    # the controller's window: decide on the SAME
+                    # batched read the health check just paid for —
+                    # everything below is host arithmetic (zero device
+                    # syncs, the host-sync lint holds apex_tpu/control/
+                    # to that).  An action that stops the run
+                    # (quarantine) flips self._stop; the standard
+                    # preempt machinery below takes it from there.
+                    with _trace.span("control.window", step=step):
+                        ctl.on_window(step=step,
+                                      losses=self._last_losses)
                 if not healthy:
                     if writer is not None:  # newest ckpt must be on disk
                         self._blocked_ckpt(step, writer.drain)
@@ -824,6 +941,9 @@ class TrainGuard:
             if ledger is not None:
                 self._finalize_goodput(ledger, tracer, prev_ledger,
                                        report)
+            if ctl is not None:
+                self._finalize_control(ctl, tracer, report)
+            self._report = None
 
     # -- health + rollback ---------------------------------------------------
     def _health_check(self, state, pending) -> bool:
@@ -837,10 +957,13 @@ class TrainGuard:
         arrays = [loss for _, loss in pending]
         if scaler is not None and cfg.floor_patience:
             arrays = arrays + [scaler.loss_scale]
+        self._last_losses: List[float] = []
         if not arrays:
             return True
         host = jax.device_get(arrays)
         losses = [float(v) for v in host[:len(pending)]]
+        self._last_losses = losses   # the controller window's context
+        # rides the SAME batched read — no second device_get
         for (st, _), v in zip(pending, losses):
             if np.isfinite(v):
                 self._streak = 0

@@ -147,6 +147,16 @@ FAULT_BADPUT = {
     # meters the real cost in its own ``shed`` class
     # (telemetry.serve_ledger)
     "request_flood": "idle",
+    # persistent per-device slowdown: the controller quarantines the
+    # named device through the elastic resize path, so the metered cost
+    # is the replan+reshard of the resumed run — resize's class (the
+    # injected in-step delay itself is slower productive time, which is
+    # exactly what a real straggler costs)
+    "straggler": "reshard",
+    # sustained synthetic badput: the guard sleeps OUTSIDE any span, so
+    # the ledger's exact partition attributes it to idle — the windowed
+    # goodput_fraction drop the controller's floor policy watches
+    "goodput_degrade": "idle",
 }
 
 #: span name -> ledger class.  Names NOT listed here (and not matching

@@ -506,7 +506,15 @@ class Registry:
     def event(self, name: str, **fields) -> None:
         """Buffer a structured event (written at the next flush).  Field
         values must be scalars/strings; device scalars are resolved at
-        flush with the batched read."""
+        flush with the batched read.
+
+        Lifecycle namespaces riding this channel: the guard's
+        resilience events (``fault_injected`` / ``rollback`` /
+        ``resumed`` / ``preempted``), elastic's ``elastic.*``, and the
+        run controller's ``control.*`` decisions (``control.decision``
+        / ``control.suppressed`` / ``control.action_failed`` — every
+        one also a row in ``CONTROL.json``), which
+        ``report.summarize`` folds into the summary's control line."""
         if not self.enabled:
             return
         self._events.append({"kind": "event", "ts": _ts(),

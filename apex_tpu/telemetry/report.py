@@ -153,6 +153,14 @@ def summarize(records: List[dict]) -> dict:
         # exported gauges — wall-clock fraction that was productive
         # training, plus the per-class badput breakdown in ms
         "goodput_fraction": gauge_last("goodput.fraction"),
+        # control (docs/control.md): the run controller's decision
+        # events — actions taken, breaches suppressed by the
+        # cooldown/max-actions gates, and actions that failed and
+        # reverted — folded next to the resilience line so a run the
+        # controller steered shows it in the same summary
+        "control_actions": len(events.get("control.decision", ())),
+        "control_suppressed": len(events.get("control.suppressed", ())),
+        "control_failed": len(events.get("control.action_failed", ())),
         # serving (docs/serve.md): the per-request latency ledger's
         # exported gauges — request counts (served/shed), tail latency,
         # and decode throughput, mirrored next to the train-side lines
@@ -238,6 +246,13 @@ def format_summary(s: dict) -> str:
                      + ("  badput: " + "  ".join(
                          f"{k.replace('_', ' ')} {v:.1f}ms"
                          for k, v in bad) if bad else ""))
+    ctl = [(k, s.get(k, 0)) for k in ("control_actions",
+                                      "control_suppressed",
+                                      "control_failed")]
+    if any(n for _, n in ctl):
+        lines.append("  control             "
+                     + "  ".join(f"{k[len('control_'):].replace('_', ' ')}"
+                                 f" {n}" for k, n in ctl if n))
     if s.get("serve_requests_served") is not None:
         parts = [f"served {s['serve_requests_served']:.0f}",
                  f"shed {s.get('serve_requests_shed') or 0:.0f}"]
@@ -369,6 +384,12 @@ def main(argv=None) -> int:
         # p50/p99/TTFT, shed counts — from a serving artifact
         from . import serve_ledger as _serve_ledger
         return _serve_ledger.cli(argv[1:])
+    if argv and argv[0] == "control":
+        # `python -m apex_tpu.telemetry control <CONTROL.json|run-dir>`:
+        # the run controller's decision ledger — counters + one row per
+        # acted/suppressed/failed decision (apex_tpu.control)
+        from ..control import ledger as _control_ledger
+        return _control_ledger.cli(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.telemetry",

@@ -383,7 +383,12 @@ def straggler_rows(steps: List[dict], *,
     from the REST of the mesh (std floored at
     ``_Z_STD_FLOOR_FRAC x rest-mean`` so a uniform mesh doesn't read
     noise as infinite z) AND is at least ``min_slowdown`` x the rest's
-    mean — both gates, the sentinel posture."""
+    mean — both gates, the sentinel posture.
+
+    Consumers: the timeline CLI's skew table, and the run controller's
+    quarantine policy (``apex_tpu.control``), which feeds per-window
+    rows through this same detector and resizes around a device the
+    z-score names persistently — the naming logic lives HERE, once."""
     out = []
     for s in steps:
         devs = s["devices"]

@@ -1511,9 +1511,17 @@ def bench_goodput(on_tpu, steps=10):
     class is exercised by the chaos tests, not this leg).  The
     embedded ``goodput`` block is audited by
     ``apply_perf_results.goodput_violations`` (classes partition the
-    wall exactly, fractions in [0, 1], replay iff restores)."""
+    wall exactly, fractions in [0, 1], replay iff restores).
+
+    A run controller (``apex_tpu.control``, default policies) rides
+    the guard's health-check window: on a clean run every signal sits
+    in-band, so the embedded ``control`` block is the NEGATIVE
+    evidence — windows evaluated, zero actions fired — and the
+    schema-valid ``CONTROL.json`` lands next to ``GOODPUT.json``.
+    ``APEX_TPU_CONTROL=0`` drops the block entirely."""
     import tempfile
 
+    from apex_tpu.control import ControlConfig, RunController
     from apex_tpu.resilience import GuardConfig, TrainGuard
     from apex_tpu.telemetry import report as treport
     from apex_tpu.telemetry import trace as tracemod
@@ -1534,10 +1542,11 @@ def bench_goodput(on_tpu, steps=10):
     prev = tracemod.set_tracer(tracer)
     t0 = time.perf_counter()
     try:
+        controller = RunController(ControlConfig())
         guard = TrainGuard(step_fn, GuardConfig(
             ckpt_dir=os.path.join(d, "ckpt"),
             save_every_steps=max(steps // 3, 1), check_every=2,
-            enabled=True))
+            enabled=True), controller=controller)
         _, rep = guard.run(state, make_batch, steps)
     finally:
         tracemod.set_tracer(prev)
@@ -1547,6 +1556,9 @@ def bench_goodput(on_tpu, steps=10):
            "wall_ms": round(wall_ms, 3), "status": rep.status,
            "checkpoints": rep.checkpoints, "artifact": rep.goodput_path,
            "goodput": doc}
+    if rep.control is not None:
+        out["control"] = rep.control
+        out["control_artifact"] = rep.control_path
     if doc is not None:
         out["goodput_fraction"] = doc["goodput_fraction"]
         gauges = {"goodput.fraction": doc["goodput_fraction"],

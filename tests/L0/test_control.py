@@ -1,0 +1,685 @@
+"""``apex_tpu.control`` (ISSUE 19): the self-driving run controller.
+
+What is proven here:
+
+  * the hysteresis gates: a value sitting exactly ON a band edge is
+    IN-band (oscillating at the edge can never flap an action),
+    ``k_consecutive`` windows must breach in a row, a fired action
+    sits out exactly ``cooldown_windows`` windows (suppressions
+    recorded, streak NOT reset) and then re-fires, and the
+    ``max_actions`` run bound caps everything after;
+  * a failing actuator degrades to ``failed_reverted`` on the
+    pre-action config — the live collective spec is reverted and the
+    run continues;
+  * the ``CONTROL.json`` ledger: writer-validates, counters derive
+    from the decision rows, the auditor catches tampered docs, the
+    CLI renders from disk;
+  * the new fault kinds ``straggler@N:F`` / ``goodput_degrade@N:F``
+    parse, validate their args, declare their badput classes;
+  * the controller itself performs ZERO host syncs, and the guard adds
+    none for it: a disabled controller is bitwise-identical to no
+    controller with the same device_get count, while an enabled one
+    rides exactly the one batched read per health-check window;
+  * THE chaos acceptances on the emulated mesh: a ``goodput_degrade``
+    run crosses the floor and replan+reshard fires (reshard badput
+    metered in GOODPUT.json), a ``straggler`` run quarantines the
+    named device via a synthesized ``resize@8:7``, and a mid-action
+    preempt resumes with the acted config re-applied from the
+    manifest's ``control`` block;
+  * ``report.summarize`` folds ``control.*`` events into the control
+    summary line.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.control import (ARTIFACT_NAME, Band, ControlActionError,
+                              ControlConfig, META_CONTROL_KEY, OUTCOMES,
+                              Policy, PolicyState, RETUNE_LADDER,
+                              RunController, build_doc,
+                              control_violations, default_policies,
+                              format_control, load_artifact, write_doc)
+from apex_tpu.control import ledger as ledger_mod
+from apex_tpu.parallel import collectives as coll
+from apex_tpu.parallel import plan as plan_mod
+from apex_tpu.resilience import CheckpointManager, GuardConfig, \
+    TrainGuard, faults
+from apex_tpu.telemetry import MemorySink, Registry, goodput
+from apex_tpu.telemetry import events as events_mod
+from apex_tpu.telemetry import trace as trace_mod
+from apex_tpu.telemetry.report import format_summary, summarize
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    prev_tr = trace_mod.set_tracer(None)
+    prev_reg = events_mod.set_default(None)
+    prev_led = goodput.install(None)
+    prev_plan = faults.install(None)
+    prev_spec = coll.set_live_spec(None)
+    yield
+    trace_mod.set_tracer(prev_tr)
+    events_mod.set_default(prev_reg)
+    goodput.install(prev_led)
+    faults.install(prev_plan)
+    coll.set_live_spec(prev_spec)
+
+
+def _ctl(policies, **cfg_kw):
+    cfg_kw.setdefault("enabled", True)
+    return RunController(ControlConfig(**cfg_kw), policies)
+
+
+def _probe_policy(**kw):
+    """A policy over an injectable signal (fed via on_window(signals=))
+    wired to a recording actuator."""
+    kw.setdefault("name", "probe")
+    kw.setdefault("signal", "probe_signal")
+    kw.setdefault("band", Band(hi=0.25))
+    kw.setdefault("action", "probe_act")
+    return Policy(**kw)
+
+
+def _recording_actuator(calls):
+    def act(ctl, pol, step):
+        calls.append(int(step))
+        return {"n": len(calls)}
+    return act
+
+
+# ---------------------------------------------------------------------------
+# bands + hysteresis
+# ---------------------------------------------------------------------------
+
+def test_band_validation_and_edge_semantics():
+    with pytest.raises(ValueError):
+        Band()                                   # no edge at all
+    with pytest.raises(ValueError):
+        Band(lo=0.5, hi=0.25)                    # inverted
+    b = Band(lo=0.25, hi=0.75)
+    assert not b.breached(0.25) and not b.breached(0.75)   # AT edge: in
+    assert b.breached(0.2499) and b.breached(0.7501)       # outside: out
+    assert not b.breached(0.5)
+    with pytest.raises(ValueError):
+        Policy(name="p", signal="s", band=b, action="a", k_consecutive=0)
+    with pytest.raises(ValueError):
+        Policy(name="p", signal="s", band=b, action="a",
+               cooldown_windows=-1)
+
+
+def test_band_edge_oscillation_never_flaps():
+    """The no-flap contract: a signal oscillating exactly between the
+    edge and in-band values never fires, however long it runs."""
+    calls = []
+    pol = _probe_policy(k_consecutive=1, cooldown_windows=0)
+    ctl = RunController(ControlConfig(enabled=True, max_actions=100),
+                        [pol], actuators={"probe_act":
+                                          _recording_actuator(calls)})
+    for w in range(50):
+        v = 0.25 if w % 2 else 0.10              # edge <-> inside
+        ctl.on_window(step=w, signals={"probe_signal": v})
+    assert calls == [] and ctl.decisions == []
+
+
+def test_k_consecutive_gates_and_in_band_reset():
+    calls = []
+    pol = _probe_policy(k_consecutive=3, cooldown_windows=0)
+    ctl = RunController(ControlConfig(enabled=True, max_actions=100),
+                        [pol], actuators={"probe_act":
+                                          _recording_actuator(calls)})
+    # two breaches, an in-band window, then three: only the streak of
+    # three fires, and only once (consec resets after the action)
+    seq = [0.9, 0.9, 0.1, 0.9, 0.9, 0.9]
+    for w, v in enumerate(seq):
+        ctl.on_window(step=w, signals={"probe_signal": v})
+    assert calls == [5]
+    # a missing signal also resets the streak
+    ctl.on_window(step=6, signals={"probe_signal": 0.9})
+    ctl.on_window(step=7, signals={})            # signal absent
+    ctl.on_window(step=8, signals={"probe_signal": 0.9})
+    ctl.on_window(step=9, signals={"probe_signal": 0.9})
+    assert calls == [5]                          # streak was 2, not 4
+    ctl.on_window(step=10, signals={"probe_signal": 0.9})
+    assert calls == [5, 10]
+
+
+def test_cooldown_suppression_refire_then_max_actions_cap():
+    """The full lifecycle under a permanent breach at k=2/cooldown=2:
+    acted once the streak reaches k, exactly ``cooldown_windows``
+    suppressed_cooldown rows per fire (k re-gates after each action,
+    and the suppressed streak is NOT reset), a clean re-fire, then the
+    max_actions=2 run bound turns every later clear window into
+    suppressed_max_actions."""
+    calls = []
+    pol = _probe_policy(k_consecutive=2, cooldown_windows=2)
+    ctl = RunController(ControlConfig(enabled=True, max_actions=2),
+                        [pol], actuators={"probe_act":
+                                          _recording_actuator(calls)})
+    outcomes = []
+    for w in range(10):
+        rows = ctl.on_window(step=w, signals={"probe_signal": 0.9})
+        outcomes.append([r["outcome"] for r in rows])
+    assert outcomes == [[], ["acted"], [], ["suppressed_cooldown"],
+                        ["suppressed_cooldown"], ["acted"], [],
+                        ["suppressed_cooldown"], ["suppressed_cooldown"],
+                        ["suppressed_max_actions"]]
+    assert calls == [1, 5] and ctl.actions_fired == 2
+    doc = ctl.snapshot(status="completed")
+    assert control_violations(doc) == []
+    assert doc["actions_fired"] == 2
+    assert doc["suppressed_cooldown"] == 4
+    assert doc["suppressed_max_actions"] == 1
+    assert doc["windows"] == 10
+
+
+def test_disabled_controller_on_window_is_inert():
+    ctl = RunController(ControlConfig(enabled=False),
+                        [_probe_policy(k_consecutive=1)])
+    assert ctl.enabled is False
+    assert ctl.on_window(step=0, signals={"probe_signal": 9.9}) == []
+    assert ctl.windows == 0 and ctl.decisions == []
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_CONTROL", "0")
+    assert ControlConfig().enabled is False
+    monkeypatch.setenv("APEX_TPU_CONTROL", "1")
+    assert ControlConfig().enabled is True
+    assert ControlConfig(enabled=False).enabled is False   # explicit wins
+
+
+# ---------------------------------------------------------------------------
+# actuators: the retune ladder + fail-safe revert
+# ---------------------------------------------------------------------------
+
+def test_comm_retune_walks_ladder_then_halves_min_bytes():
+    pol = Policy(name="comm", signal="exposed_comm_fraction",
+                 band=Band(hi=0.25), action="comm_retune",
+                 k_consecutive=1, cooldown_windows=0)
+    ctl = _ctl([pol], max_actions=10)
+    schemes = []
+    for w in range(4):
+        rows = ctl.on_window(step=w,
+                             signals={"exposed_comm_fraction": 0.6})
+        assert rows[0]["outcome"] == "acted"
+        spec = coll.get_live_spec()
+        schemes.append((spec.scheme, spec.min_bytes))
+    base = coll.CollectiveSpec().min_bytes
+    assert [s for s, _ in schemes] == ["bf16", "int8_blockscale",
+                                       "int8_blockscale",
+                                       "int8_blockscale"]
+    assert [m for _, m in schemes][2:] == [base // 2, base // 4]
+    # the live override is what resolve() hands the next engine build
+    assert coll.resolve(None).scheme == "int8_blockscale"
+    # explicit argument still wins over the live override
+    assert coll.resolve("fp32").scheme == "fp32"
+
+
+def test_live_spec_precedence_over_env(monkeypatch):
+    monkeypatch.setenv(coll.ENV_KNOB, "adasum")
+    assert coll.resolve(None).scheme == "adasum"
+    coll.set_live_spec("bf16")
+    assert coll.resolve(None).scheme == "bf16"   # live beats env
+    coll.set_live_spec(None)
+    assert coll.resolve(None).scheme == "adasum"
+
+
+def test_action_failure_reverts_live_spec_and_records():
+    """comm_retune with a manager whose update_meta raises: the spec
+    walk is reverted, the decision is failed_reverted, the
+    control.action_failed event fires, and the run-facing API never
+    raises."""
+    class BadManager:
+        def update_meta(self, patch):
+            raise OSError("disk full")
+
+    reg = Registry(sink=MemorySink(), flush_interval=0, rank0_only=False)
+    pol = Policy(name="comm", signal="exposed_comm_fraction",
+                 band=Band(hi=0.25), action="comm_retune",
+                 k_consecutive=1, cooldown_windows=0)
+    ctl = RunController(ControlConfig(enabled=True, max_actions=10),
+                        [pol], registry=reg)
+    ctl.arm(manager=BadManager())
+    before = coll.get_live_spec()
+    rows = ctl.on_window(step=3,
+                         signals={"exposed_comm_fraction": 0.6})
+    assert rows[0]["outcome"] == "failed_reverted"
+    assert "disk full" in rows[0]["detail"]["error"]
+    assert coll.get_live_spec() == before        # reverted
+    assert ctl.actions_fired == 0                # failed != acted
+    names = [r["name"] for r in reg.flush() if r.get("kind") == "event"]
+    assert "control.action_failed" in names
+    doc = ctl.snapshot()
+    assert control_violations(doc) == []
+    assert doc["failed_reverted"] == 1
+
+
+def test_replan_without_profile_degrades_to_failed_reverted():
+    pol = Policy(name="gp", signal="goodput_fraction",
+                 band=Band(lo=0.5), action="replan_reshard",
+                 k_consecutive=1, cooldown_windows=0)
+    ctl = _ctl([pol], max_actions=3)             # profile=None
+    rows = ctl.on_window(step=0, signals={"goodput_fraction": 0.1})
+    assert rows[0]["outcome"] == "failed_reverted"
+    assert "profile" in rows[0]["detail"]["error"]
+
+
+def test_quarantine_without_context_degrades():
+    pol = Policy(name="sq", signal="straggler_windows",
+                 band=Band(hi=1.5), action="quarantine",
+                 k_consecutive=1, cooldown_windows=0)
+    ctl = _ctl([pol], max_actions=3)             # no guard, no device
+    rows = ctl.on_window(step=0, signals={"straggler_windows": 3.0})
+    assert rows[0]["outcome"] == "failed_reverted"
+
+
+def test_default_policy_table():
+    pols = default_policies()
+    by_action = {p.action: p for p in pols}
+    assert set(by_action) == {"comm_retune", "replan_reshard",
+                              "quarantine"}
+    assert by_action["comm_retune"].signal == "exposed_comm_fraction"
+    assert by_action["replan_reshard"].band.lo == 0.5
+    assert by_action["quarantine"].k_consecutive == 1
+    st = PolicyState()
+    assert st.consec == 0 and st.cooldown_left == 0
+
+
+# ---------------------------------------------------------------------------
+# the straggler signal
+# ---------------------------------------------------------------------------
+
+def test_straggler_streak_from_fed_rows():
+    pol = Policy(name="sq", signal="straggler_windows",
+                 band=Band(hi=1.5), action="quarantine",
+                 k_consecutive=1, cooldown_windows=0)
+    calls = []
+    ctl = RunController(ControlConfig(enabled=True, max_actions=10),
+                        [pol],
+                        actuators={"quarantine":
+                                   _recording_actuator(calls)})
+
+    def feed(step, slow_dev):
+        devs = {f"d{i}": 1.0 for i in range(8)}
+        devs[slow_dev] = 8.0
+        ctl.feed_device_stats(step, devs)
+
+    feed(0, "d3"); feed(1, "d3")
+    ctl.on_window(step=1)
+    assert ctl._named_device == "d3" and ctl._streak == 1
+    assert calls == []                           # 1 window: not > 1.5
+    feed(2, "d3"); feed(3, "d3")
+    ctl.on_window(step=3)
+    assert ctl._streak == 2 and calls == [3]     # 2 windows: quarantine
+    # a DIFFERENT named device resets the streak
+    feed(4, "d5"); feed(5, "d5")
+    ctl.on_window(step=5)
+    assert ctl._named_device == "d5" and ctl._streak == 1
+    # an empty window preserves (but does not extend) the streak
+    ctl.on_window(step=7)
+    assert ctl._streak == 1
+
+
+def test_controller_performs_zero_host_syncs(monkeypatch):
+    syncs = []
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: syncs.append("get") or x)
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda x: syncs.append("block") or x)
+    led = goodput.GoodputLedger()
+    led.note_span("train.step", led.t0_us + 1000.0, 500.0, step=0)
+    goodput.install(led)
+    ctl = _ctl(default_policies(), max_actions=3)
+    for w in range(5):
+        ctl.feed_device_stats(w, {f"d{i}": 1.0 for i in range(8)})
+        ctl.on_window(step=w)
+    ctl.snapshot(status="completed")
+    assert syncs == []
+
+
+# ---------------------------------------------------------------------------
+# the CONTROL.json ledger
+# ---------------------------------------------------------------------------
+
+def _valid_doc():
+    pols = [p.row() for p in default_policies()]
+    decs = [{"window": 2, "step": 4, "policy": "exposed_comm_ceiling",
+             "signal": "exposed_comm_fraction", "value": 0.41,
+             "lo": None, "hi": 0.25, "action": "comm_retune",
+             "outcome": "acted", "detail": {"from": "fp32", "to": "bf16"}},
+            {"window": 4, "step": 8, "policy": "exposed_comm_ceiling",
+             "signal": "exposed_comm_fraction", "value": 0.31,
+             "lo": None, "hi": 0.25, "action": "comm_retune",
+             "outcome": "suppressed_cooldown", "detail": {}}]
+    return build_doc(enabled=True, windows=6, max_actions=3,
+                     policies=pols, decisions=decs, status="completed")
+
+
+def test_ledger_build_write_load_roundtrip(tmp_path):
+    doc = _valid_doc()
+    assert control_violations(doc) == []
+    assert doc["actions_fired"] == 1             # derived from rows
+    assert doc["suppressed_cooldown"] == 1
+    path = write_doc(doc, directory=str(tmp_path))
+    assert os.path.basename(path) == ARTIFACT_NAME
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    assert load_artifact(str(tmp_path)) == load_artifact(path)
+    txt = format_control(doc)
+    assert "actions=1/3" in txt and "comm_retune" in txt
+    assert "suppressed_cooldown" in txt
+
+
+def test_ledger_auditor_catches_tampering(tmp_path):
+    doc = _valid_doc()
+    bad = dict(doc, actions_fired=5)             # counter != rows
+    assert any("actions_fired" in v for v in control_violations(bad))
+    with pytest.raises(ValueError):
+        write_doc(bad, directory=str(tmp_path))  # writer-validates
+    assert not os.path.exists(tmp_path / ARTIFACT_NAME)
+    bad2 = dict(doc)
+    bad2["decisions"] = [dict(doc["decisions"][0], outcome="vibes")]
+    assert any("outcome" in v for v in control_violations(bad2))
+    bad3 = dict(doc)
+    bad3["decisions"] = [dict(doc["decisions"][0], policy="ghost")]
+    assert any("not in the policy table" in v
+               for v in control_violations(bad3))
+    assert any("max_actions" in v for v in control_violations(
+        dict(doc, actions_fired=9, max_actions=3)))
+    assert control_violations([]) and control_violations(None)
+
+
+def test_ledger_cli(tmp_path, capsys):
+    path = write_doc(_valid_doc(), directory=str(tmp_path))
+    assert ledger_mod.cli([path]) == 0
+    out = capsys.readouterr().out
+    assert "control ledger" in out and "acted" in out
+    assert ledger_mod.cli([str(tmp_path)]) == 0  # run-dir form
+    capsys.readouterr()
+    junk = tmp_path / "junk.json"
+    junk.write_text("{}")
+    assert ledger_mod.cli([str(junk)]) == 1
+    assert "error" in capsys.readouterr().out
+
+
+def test_outcomes_enum_matches_counters():
+    assert set(OUTCOMES) == {"acted", "suppressed_cooldown",
+                             "suppressed_max_actions", "failed_reverted"}
+
+
+# ---------------------------------------------------------------------------
+# the new fault kinds
+# ---------------------------------------------------------------------------
+
+def test_fault_grammar_straggler_and_goodput_degrade():
+    plan = faults.parse("straggler@2x4:4.0;goodput_degrade@3:0.02")
+    assert {"straggler", "goodput_degrade"} <= set(faults.KINDS)
+    s = plan.fire("straggler", 2)
+    assert s is not None and s.arg == 4.0
+    g = plan.fire("goodput_degrade", 3)
+    assert g is not None and g.arg == 0.02
+    with pytest.raises(ValueError):
+        faults.parse("straggler@2:1.0")          # factor must be > 1
+    with pytest.raises(ValueError):
+        faults.parse("straggler@2")              # factor required
+    with pytest.raises(ValueError):
+        faults.parse("goodput_degrade@2:0")      # seconds must be > 0
+
+
+def test_straggler_delay_curve():
+    assert faults.straggler_delay(1.0) == 0.0
+    assert faults.straggler_delay(4.0) == pytest.approx(
+        faults.STRAGGLER_BASE_S * 3.0)
+    assert faults.straggler_delay(1e9) == faults.STRAGGLER_CAP_S
+
+
+def test_fault_badput_declares_new_kinds():
+    assert goodput.FAULT_BADPUT["straggler"] == "reshard"
+    assert goodput.FAULT_BADPUT["goodput_degrade"] == "idle"
+    for kind in faults.KINDS:                    # completeness holds
+        assert kind in goodput.FAULT_BADPUT, kind
+
+
+# ---------------------------------------------------------------------------
+# report folds control.* events
+# ---------------------------------------------------------------------------
+
+def test_report_control_summary_line():
+    reg = Registry(sink=MemorySink(), flush_interval=0, rank0_only=False)
+    reg.event("control.decision", step=4, policy="exposed_comm_ceiling",
+              action="comm_retune", outcome="acted")
+    reg.event("control.decision", step=9, policy="goodput_floor",
+              action="replan_reshard", outcome="acted")
+    reg.event("control.suppressed", step=6, policy="exposed_comm_ceiling",
+              outcome="suppressed_cooldown")
+    reg.event("control.action_failed", step=12, policy="goodput_floor",
+              error="ControlActionError('no profile')")
+    s = summarize(reg.flush())
+    assert s["control_actions"] == 2
+    assert s["control_suppressed"] == 1
+    assert s["control_failed"] == 1
+    fs = format_summary(s)
+    assert "control" in fs
+    assert "actions 2" in fs and "suppressed 1" in fs and "failed 1" in fs
+    # no control events -> no control line
+    assert "control" not in format_summary(summarize([]))
+
+
+# ---------------------------------------------------------------------------
+# guard integration: the no-op contract + one read per window
+# ---------------------------------------------------------------------------
+
+def _sgd_step():
+    @jax.jit
+    def step(w, batch):
+        g = jax.grad(lambda w: jnp.sum((w - batch) ** 2))(w)
+        return w - 0.1 * g, jnp.sum((w - batch) ** 2)
+    return step
+
+
+def _batch_at(i):
+    return jnp.asarray(np.random.RandomState(i).randn(4).astype(np.float32))
+
+
+def test_disabled_controller_is_bitwise_noop_with_no_extra_syncs(
+        monkeypatch, tmp_path):
+    gets = []
+    real_get = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: gets.append(1) or real_get(x))
+
+    def run(controller, d):
+        cfg = GuardConfig(ckpt_dir=str(d), save_every_steps=5,
+                          check_every=5, backoff_seconds=0.01,
+                          enabled=True)
+        return TrainGuard(_sgd_step(), cfg, controller=controller).run(
+            jnp.zeros(4), _batch_at, 20)
+
+    w_none, r_none = run(None, tmp_path / "a")
+    n_none = len(gets)
+    gets.clear()
+    ctl = RunController(ControlConfig(enabled=False))
+    w_off, r_off = run(ctl, tmp_path / "b")
+    assert np.array_equal(np.asarray(w_none), np.asarray(w_off))
+    assert len(gets) == n_none                   # zero extra host reads
+    assert r_off.control is None and r_off.control_path is None
+    assert ctl.windows == 0
+    assert not os.path.exists(tmp_path / "b" / ARTIFACT_NAME)
+
+
+def test_enabled_controller_rides_one_read_per_window(monkeypatch,
+                                                      tmp_path):
+    gets = []
+    real_get = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: gets.append(1) or real_get(x))
+    ctl = RunController(ControlConfig(enabled=True))
+    cfg = GuardConfig(check_every=10, enabled=True)   # no ckpt dir: the
+    _, rep = TrainGuard(_sgd_step(), cfg, controller=ctl).run(
+        jnp.zeros(4), _batch_at, 20)                  # gets are windows
+    assert rep.status == "completed"
+    assert len(gets) == 2                        # one per window, total
+    assert ctl.windows == 2
+    assert rep.control is not None
+    assert control_violations(rep.control) == []
+    assert rep.control["windows"] == 2
+    assert rep.control["decisions"] == []        # healthy run: no acts
+    monkeypatch.undo()
+    # with a checkpoint dir the ledger lands on the flight-destination
+    # chain as CONTROL.json
+    ctl2 = RunController(ControlConfig(enabled=True))
+    cfg2 = GuardConfig(ckpt_dir=str(tmp_path), save_every_steps=0,
+                       check_every=10, backoff_seconds=0.01,
+                       enabled=True)
+    _, rep2 = TrainGuard(_sgd_step(), cfg2, controller=ctl2).run(
+        jnp.zeros(4), _batch_at, 20)
+    doc = load_artifact(rep2.control_path)
+    assert doc["status"] == "completed" and doc["windows"] == 2
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptances (emulated 8-dev mesh via world_size=8)
+# ---------------------------------------------------------------------------
+
+def _tiny_profile():
+    return plan_mod.ModelProfile(
+        name="tiny", flops=1e9, bytes_accessed=1e8,
+        params_bytes=1 << 20, optimizer_bytes=3 << 20,
+        activations_bytes=1 << 20, batch_bytes=1 << 16,
+        temps_bytes=1 << 18, output_bytes=1 << 10, platform="cpu")
+
+
+def test_chaos_goodput_degrade_fires_replan_reshard(tmp_path):
+    """Acceptance (a): a goodput_degrade fault drags the windowed
+    goodput fraction below the floor for K consecutive windows ->
+    replan_reshard fires, the decision lands in a schema-valid
+    CONTROL.json, and the mid-run plan.search is metered as reshard
+    badput in GOODPUT.json."""
+    tr = trace_mod.Tracer(enabled=True, flight_dir=str(tmp_path))
+    prev = trace_mod.set_tracer(tr)
+    try:
+        plan = faults.parse("goodput_degrade@2x20:0.02")
+        ctl = RunController(ControlConfig(
+            enabled=True, max_actions=1, profile=_tiny_profile()))
+        cfg = GuardConfig(ckpt_dir=str(tmp_path / "ck"),
+                          save_every_steps=2, check_every=2,
+                          backoff_seconds=0.01, enabled=True,
+                          world_size=8)
+        _, rep = TrainGuard(_sgd_step(), cfg, plan=plan,
+                            controller=ctl).run(
+            jnp.zeros(4), _batch_at, 10)
+    finally:
+        trace_mod.set_tracer(prev)
+    assert rep.status == "completed"
+    doc = rep.control
+    assert doc is not None and control_violations(doc) == []
+    acted = [d for d in doc["decisions"]
+             if d["outcome"] == "acted" and d["action"] == "replan_reshard"]
+    assert len(acted) == 1
+    assert acted[0]["value"] < 0.5               # the breached floor
+    assert acted[0]["detail"]["chips"] == 8
+    assert acted[0]["detail"]["predicted_step_ms"] > 0
+    # the acted plan persisted to the manifest (the elastic contract)
+    _, _, meta = CheckpointManager(str(tmp_path / "ck")).load_latest(
+        with_meta=True)
+    assert meta[META_CONTROL_KEY]["plan"]["dp"] >= 1
+    assert isinstance(meta["plan"], dict)
+    # the search itself was metered as reshard badput
+    gdoc = rep.goodput
+    assert gdoc is not None
+    assert gdoc["classes"]["reshard"]["ms"] > 0.0
+    assert gdoc["classes"]["idle"]["ms"] > 0.0   # the injected sleeps
+
+
+def test_chaos_straggler_quarantines_via_elastic_resize(tmp_path):
+    """Acceptance (b), the in-suite leg (tools/control_chaos.py proves
+    the full 8->7 bitwise resume on the real zero1 mesh): a persistent
+    straggler is named by the leave-one-out z-score for >= 2 windows,
+    the quarantine policy fires, and the run exits through the guard's
+    synthesized resize@8:7 with the decision trail on disk."""
+    reg = Registry(sink=MemorySink(), flush_interval=0, rank0_only=False)
+    plan = faults.parse("straggler@2x40:4.0")
+    ctl = RunController(ControlConfig(enabled=True, max_actions=2),
+                        registry=reg)
+    cfg = GuardConfig(ckpt_dir=str(tmp_path), save_every_steps=2,
+                      check_every=2, backoff_seconds=0.01, enabled=True,
+                      world_size=8)
+    _, rep = TrainGuard(_sgd_step(), cfg, plan=plan, registry=reg,
+                        controller=ctl).run(jnp.zeros(4), _batch_at, 30)
+    assert rep.status == "preempted"
+    assert rep.resize_to == 7                    # the synthesized resize
+    doc = rep.control
+    assert doc is not None and control_violations(doc) == []
+    q = [d for d in doc["decisions"]
+         if d["action"] == "quarantine" and d["outcome"] == "acted"]
+    assert len(q) == 1
+    assert q[0]["detail"] == {"device": "d0", "from_world": 8,
+                              "to_world": 7}     # culprit = seed % world
+    assert q[0]["value"] >= 2.0                  # the streak that named it
+    # quarantine context persisted for the post-resize run
+    _, _, meta = CheckpointManager(str(tmp_path)).load_latest(
+        with_meta=True)
+    assert meta[META_CONTROL_KEY]["quarantined_device"] == "d0"
+    assert meta[META_CONTROL_KEY]["resize_to"] == 7
+    names = [r["name"] for r in reg.flush() if r.get("kind") == "event"]
+    assert "control.resize_requested" in names
+    assert "control.decision" in names
+
+
+def test_chaos_midaction_preempt_resumes_with_acted_config(tmp_path):
+    """Satellite (c): an action fires, the run is preempted before the
+    next natural save, and the resumed run re-applies the acted config
+    from the manifest's control block (control.rearmed) instead of
+    silently starting on the pre-action wire."""
+    pol = Policy(name="gp_probe", signal="goodput_fraction",
+                 band=Band(lo=2.0), action="comm_retune",
+                 k_consecutive=1, cooldown_windows=0)
+    tr = trace_mod.Tracer(enabled=True, flight_dir=str(tmp_path))
+    prev = trace_mod.set_tracer(tr)
+    try:
+        cfg = lambda: GuardConfig(                           # noqa: E731
+            ckpt_dir=str(tmp_path / "ck"), save_every_steps=2,
+            check_every=2, backoff_seconds=0.01, enabled=True)
+        ctl1 = RunController(ControlConfig(enabled=True, max_actions=1),
+                             [pol])
+        plan = faults.parse("preempt@5")
+        _, r1 = TrainGuard(_sgd_step(), cfg(), plan=plan,
+                           controller=ctl1).run(jnp.zeros(4),
+                                                _batch_at, 20)
+        assert r1.status == "preempted"
+        assert ctl1.actions_fired == 1
+        spec = coll.get_live_spec()
+        assert spec is not None and spec.scheme == "bf16"
+        _, _, meta = CheckpointManager(str(tmp_path / "ck")).load_latest(
+            with_meta=True)
+        assert meta[META_CONTROL_KEY]["live_collective"].startswith(
+            "bf16")
+
+        # "restart the process": the live override is gone, a fresh
+        # controller must restore it from the manifest at arm()
+        coll.set_live_spec(None)
+        reg = Registry(sink=MemorySink(), flush_interval=0,
+                       rank0_only=False)
+        ctl2 = RunController(ControlConfig(enabled=True, max_actions=0),
+                             [pol], registry=reg)
+        _, r2 = TrainGuard(_sgd_step(), cfg(),
+                           controller=ctl2).run(jnp.zeros(4),
+                                                _batch_at, 20)
+    finally:
+        trace_mod.set_tracer(prev)
+    assert r2.status == "completed" and r2.resumed_from == 5
+    spec = coll.get_live_spec()
+    assert spec is not None and spec.scheme == "bf16"   # re-applied
+    rearmed = [r for r in reg.flush()
+               if r.get("kind") == "event"
+               and r["name"] == "control.rearmed"]
+    assert len(rearmed) == 1
+    assert rearmed[0]["fields"]["live_collective"].startswith("bf16")
+    # and the re-merged block kept surviving the resumed run's saves
+    _, _, meta2 = CheckpointManager(str(tmp_path / "ck")).load_latest(
+        with_meta=True)
+    assert meta2[META_CONTROL_KEY]["live_collective"].startswith("bf16")

@@ -59,6 +59,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_ELASTIC_CMD": "",
            # and its real-data twin (stage 3b-real)
            "APEX_WATCH_ELASTIC_REAL_CMD": "",
+           # and the run-controller straggler-chaos proof (stage 3c)
+           "APEX_WATCH_CONTROL_CMD": "",
            # and the bench-trend/goodput watchdog (stage 4b)
            "APEX_WATCH_TREND_CMD": "",
            "PYTHONPATH": ROOT,
@@ -857,6 +859,54 @@ def test_elastic_real_data_stage(tmp_path):
     assert "elastic real-data proof done rc=1" in log3
     assert not (tmp_path / "REAL_FAIL.json").exists()
     assert not (tmp_path / "REAL_FAIL.json.run").exists()
+
+
+def test_control_chaos_stage(tmp_path):
+    """ISSUE 19 satellite: the run-controller straggler-chaos proof
+    runs as watch stage 3c — artifact written atomically, a
+    `watch.control` span appended to the streaming timeline,
+    skip-when-complete, and a failing proof leaves no truncated
+    artifact behind (mirror of stage 3b)."""
+    fake = json.dumps({"metric": "control_chaos", "backend": "tpu",
+                       "from_world": 8, "to_world": 7,
+                       "quarantine_decisions": 1, "control_valid": True,
+                       "bitwise": True})
+    marker = tmp_path / "control_calls"
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+    }
+    r, log = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_CONTROL_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    art = json.loads((tmp_path / "CONTROL_CHAOS_r5.json").read_text())
+    assert art["quarantine_decisions"] == 1 and art["bitwise"] is True
+    assert "control chaos proof done rc=0" in log
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.control" in names
+    # skip-when-complete on the next window
+    r2, _ = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_CONTROL_CMD": f"echo run >> {marker}; echo '{fake}'",
+    })
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+    # a failing proof (rc!=0: the quarantine/bitwise gate) leaves no
+    # truncated artifact behind, and a later window retries
+    r3, log3 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_CONTROL_JSON": "CONTROL_FAIL.json",
+        "APEX_WATCH_CONTROL_CMD": "echo '{\"bitwise\":false'; false",
+    })
+    assert r3.returncode == 0
+    assert "control chaos proof done rc=1" in log3
+    assert not (tmp_path / "CONTROL_FAIL.json").exists()
+    assert not (tmp_path / "CONTROL_FAIL.json.run").exists()
 
 
 def test_bench_trend_stage_artifact_and_span(tmp_path):

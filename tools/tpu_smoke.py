@@ -281,6 +281,55 @@ def check_serve_compile(tiny):
     return float(failed)
 
 
+def check_control(tiny):
+    """Run-controller smoke (ISSUE 19): arm a
+    ``apex_tpu.control.RunController``, evaluate windows over injected
+    signals, and fire one no-op-safe ``comm_retune`` action on the CPU
+    mesh — in-band windows must stay silent, a K-consecutive breach
+    must flip the live collective override one ladder rung, and the
+    resulting ``CONTROL.json`` doc must pass its own schema.  Value is
+    the failure count (0.0 = controller arms, gates, acts, audits);
+    the live override is restored either way.  Tiny and production
+    variants run the same logic — the controller is host arithmetic."""
+    from apex_tpu.control import (ControlConfig, RunController,
+                                  control_violations, default_policies)
+    from apex_tpu.parallel import collectives as coll
+
+    failed = 0
+    prev_live = coll.get_live_spec()
+    try:
+        ctl = RunController(ControlConfig(enabled=True, max_actions=1),
+                            policies=default_policies())
+        ctl.arm(live_world=8)
+        # window 1: everything in-band (exactly AT the ceiling counts
+        # as in-band — the no-flap edge) -> no decisions
+        if ctl.on_window(step=1, signals={"exposed_comm_fraction": 0.25,
+                                          "goodput_fraction": 0.9}):
+            failed += 1
+        # windows 2..3: exposed-comm breach for k_consecutive=2 ->
+        # exactly one acted comm_retune, fp32 -> bf16 live
+        coll.set_live_spec(None)
+        decisions = []
+        for w in (2, 3):
+            decisions += ctl.on_window(
+                step=w, signals={"exposed_comm_fraction": 0.6,
+                                 "goodput_fraction": 0.9})
+        acted = [d for d in decisions if d["outcome"] == "acted"]
+        if len(acted) != 1 or acted[0]["action"] != "comm_retune":
+            failed += 1
+        live = coll.get_live_spec()
+        if live is None or live.scheme != "bf16":
+            failed += 1
+        doc = ctl.snapshot(status="completed")
+        if control_violations(doc) or doc["actions_fired"] != 1:
+            failed += 1
+    except Exception:
+        failed += 1
+    finally:
+        coll.set_live_spec(prev_live)
+    return float(failed)
+
+
 # check name -> (fn, relative-error tolerance).  bf16 kernels compare
 # bf16-vs-bf16 math but accumulate differently (blocked f32 partials vs
 # one einsum), hence the looser flash tolerances.
@@ -301,6 +350,9 @@ CHECKS = {
     # not a numerics check: the value is the count of serving O-levels
     # whose engine failed to compile+run prefill/decode — 0 required
     "serve_compile": (check_serve_compile, 0.5),
+    # not a numerics check: the value is the count of run-controller
+    # contract failures (arm/gate/act/audit) — 0 required
+    "control": (check_control, 0.5),
 }
 
 

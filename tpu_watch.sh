@@ -107,6 +107,16 @@ ELASTIC_TO=${APEX_WATCH_ELASTIC_TO:-400}
 ELASTIC_REAL_CMD=${APEX_WATCH_ELASTIC_REAL_CMD-"python tools/elastic_proof.py --real-data"}
 ELASTIC_REAL_JSON=${APEX_WATCH_ELASTIC_REAL_JSON:-ELASTIC_PROOF_REAL_r5.json}
 ELASTIC_REAL_TO=${APEX_WATCH_ELASTIC_REAL_TO:-400}
+# stage 3c: the run-controller chaos proof (ISSUE 19) — train N-way
+# with an injected persistent straggler, let the RunController's
+# quarantine policy resize around the named device, resume (N-1)-way
+# elastically, assert bitwise params vs an independent checkpoint
+# import AND a schema-valid CONTROL.json with >= 1 quarantine
+# decision.  ${VAR-default}: an explicitly EMPTY override disables
+# the stage
+CONTROL_CMD=${APEX_WATCH_CONTROL_CMD-"python tools/control_chaos.py"}
+CONTROL_JSON=${APEX_WATCH_CONTROL_JSON:-CONTROL_CHAOS_r5.json}
+CONTROL_TO=${APEX_WATCH_CONTROL_TO:-400}
 # stage 2b: collective-scheme A/B (fp32 vs bf16/int8/adasum wire bytes +
 # host ms, ISSUE 7) — cheap enough for a short window, and the artifact
 # feeds apply_perf_results' ddp_collective_scheme decision
@@ -495,6 +505,21 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$ELASTIC_REAL_JSON".run
       fi
       echo "$(date +%H:%M:%S) elastic real-data proof done rc=$rcer" >> "$LOG"
+    fi
+    # ---- stage 3c: run-controller straggler-chaos proof ----
+    if [ -n "$CONTROL_CMD" ] && [ ! -s "$CONTROL_JSON" ]; then
+      t0=$(now_us)
+      timeout -k 10 "$CONTROL_TO" bash -c "$CONTROL_CMD" > "$CONTROL_JSON".run 2>> "$LOG"
+      rcc=$?   # capture BEFORE the $(date) substitution resets $?
+      stage_span control "$t0" "$rcc"
+      stage_mem
+      if [ $rcc -eq 0 ] && [ -s "$CONTROL_JSON".run ]; then
+        mv "$CONTROL_JSON".run "$CONTROL_JSON"
+      else
+        # a wedged/failed proof never leaves a truncated artifact behind
+        rm -f "$CONTROL_JSON".run
+      fi
+      echo "$(date +%H:%M:%S) control chaos proof done rc=$rcc" >> "$LOG"
     fi
     # ---- stage 3: training run with save/resume (numerics proof) ----
     # AFTER the incremental bench stages: an all-or-nothing TRAIN_TO-long
