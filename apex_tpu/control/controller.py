@@ -24,7 +24,16 @@ absent this window simply resets its streak):
   * ``exposed_comm_fraction`` — exposed_comm-ms delta / wall-ms delta;
   * ``straggler_windows``     — how many CONSECUTIVE windows the same
     device has been named by the leave-one-out z-score over the rows
-    fed via :meth:`feed_device_stats` / :meth:`feed_decomposition`.
+    fed via :meth:`feed_device_stats` / :meth:`feed_decomposition`;
+  * ``plateau_windows``       — how many CONSECUTIVE windows the
+    window-mean loss failed to improve by at least a relative 1e-3
+    (ROADMAP controller phase 2: computed from the window's
+    already-resolved host losses, exported as the
+    ``loss.plateau_windows`` gauge — a signal policies MAY band on;
+    none does by default, no new actuator);
+  * ``grad_noise_proxy``      — within-window relative loss spread
+    (sample std / |mean|), the cheap stand-in for the gradient-noise
+    scale's batch-noise term, exported as ``loss.grad_noise_proxy``.
 
 Actions are bounded (``max_actions`` per run), hysteresis-gated
 (``policy.py``), rate-limited by per-policy cooldowns, and fail-safe:
@@ -269,6 +278,8 @@ class RunController:
         self._named_device: Optional[str] = None
         self._prev_wall: Optional[float] = None
         self._prev_class_ms: Dict[str, float] = {}
+        self._loss_prev_mean: Optional[float] = None
+        self._plateau_windows = 0
 
     # -- run wiring ----------------------------------------------------------
     @property
@@ -378,6 +389,53 @@ class RunController:
         self._prev_wall = wall
         self._prev_class_ms = class_ms
 
+    #: window-over-window relative improvement below this extends the
+    #: plateau streak
+    PLATEAU_REL_IMPROVEMENT = 1e-3
+
+    def _loss_signals(self, sig: Dict[str, float],
+                      losses: Optional[List[float]]) -> None:
+        """``plateau_windows`` / ``grad_noise_proxy`` from the window's
+        already-resolved host losses (ROADMAP controller phase 2).
+        Pure float arithmetic on numbers the health check already paid
+        for — zero new syncs — exported as ``loss.*`` gauges so they
+        stream through the live exporter and land in FLEET.json's
+        per-host loss block.  Signals only: no default policy bands on
+        them and no new actuator exists."""
+        vals = []
+        for v in losses or ():
+            try:
+                f = float(v)
+            except (TypeError, ValueError):
+                continue
+            if f == f and f not in (float("inf"), float("-inf")):
+                vals.append(f)
+        if not vals:
+            return
+        mean = sum(vals) / len(vals)
+        if len(vals) >= 2:
+            var = sum((v - mean) ** 2 for v in vals) / (len(vals) - 1)
+            sig["grad_noise_proxy"] = (var ** 0.5) / max(abs(mean), 1e-12)
+        prev, self._loss_prev_mean = self._loss_prev_mean, mean
+        if prev is not None:
+            rel = (prev - mean) / max(abs(prev), 1e-12)
+            if rel < self.PLATEAU_REL_IMPROVEMENT:
+                self._plateau_windows += 1
+            else:
+                self._plateau_windows = 0
+            sig["plateau_windows"] = float(self._plateau_windows)
+        reg = self._registry
+        if reg is None:
+            from ..telemetry import events as _events
+            reg = _events.get_default()
+        if reg is not None and getattr(reg, "enabled", False):
+            if "plateau_windows" in sig:
+                reg.gauge("loss.plateau_windows").set(
+                    sig["plateau_windows"])
+            if "grad_noise_proxy" in sig:
+                reg.gauge("loss.grad_noise_proxy").set(
+                    sig["grad_noise_proxy"])
+
     def _straggler_signal(self, sig: Dict[str, float]) -> None:
         rows, self._rows = self._rows, []
         if not rows:
@@ -414,16 +472,18 @@ class RunController:
                   ) -> List[dict]:
         """Evaluate one health-check window at global ``step``.  The
         guard calls this right after its batched host read; ``losses``
-        are the already-resolved host floats from that same read (policy
-        signals over loss live here one day; today they're recorded
-        context only).  ``signals`` injects/overrides signal values —
-        the harness/test surface; live signals are computed first, then
-        overridden.  Returns this window's decision rows."""
+        are the already-resolved host floats from that same read,
+        folded into the ``plateau_windows`` / ``grad_noise_proxy``
+        signals (and ``loss.*`` gauges) by :meth:`_loss_signals`.
+        ``signals`` injects/overrides signal values — the harness/test
+        surface; live signals are computed first, then overridden.
+        Returns this window's decision rows."""
         if not self.enabled:
             return []
         self.windows += 1
         sig: Dict[str, float] = {}
         self._goodput_signals(sig)
+        self._loss_signals(sig, losses)
         self._straggler_signal(sig)
         if signals:
             sig.update({k: float(v) for k, v in signals.items()})

@@ -181,6 +181,11 @@ class GuardReport:
     #: enabled controller rode the run
     control: Optional[dict] = None
     control_path: Optional[str] = None
+    #: the live OpenMetrics scrape URL (``telemetry.export``) this run
+    #: served — None unless ``APEX_TPU_METRICS_PORT`` armed the
+    #: endpoint (the run identity is stamped on the exporter, so a
+    #: scrape names which run it is reading)
+    export_url: Optional[str] = None
 
 
 def _observed_save(manager: CheckpointManager, step: int, payload,
@@ -678,6 +683,21 @@ class TrainGuard:
         from ..telemetry import trace as _trace
 
         live_world = cfg.world_size or _infer_world(state)
+        # the live OpenMetrics endpoint (telemetry.export): armed only
+        # when APEX_TPU_METRICS_PORT is set — otherwise maybe_start
+        # allocates nothing (the disabled-mode contract).  Stamped with
+        # this run's identity; shut down in the finally iff THIS run
+        # started it (a pre-installed exporter outlives the run)
+        from ..telemetry import export as _export
+        _exp_owned = _export.get_exporter() is None
+        _reg = (self._registry if self._registry is not None
+                else _tel_events.get_default())
+        exporter = _export.maybe_start(
+            run_id=getattr(_reg, "run_id", None) or f"guard-{os.getpid()}")
+        _exp_owned = _exp_owned and exporter is not None
+        if exporter is not None:
+            exporter.set_meta(world=live_world, pid=os.getpid())
+            report.export_url = exporter.url
         if mgr is not None:
             meta = {}
             if live_world:
@@ -943,6 +963,8 @@ class TrainGuard:
                                        report)
             if ctl is not None:
                 self._finalize_control(ctl, tracer, report)
+            if _exp_owned:
+                _export.shutdown()
             self._report = None
 
     # -- health + rollback ---------------------------------------------------

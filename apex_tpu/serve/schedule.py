@@ -95,6 +95,11 @@ class ContinuousBatcher:
         self.ledger = ledger if ledger is not None else ServeLedger()
         self.registry = registry
         self.tracer = tracer
+        # live export (telemetry.export): a serving process has no
+        # TrainGuard to arm the endpoint, so the scheduler does — a
+        # no-op (nothing allocated) unless APEX_TPU_METRICS_PORT is set
+        from ..telemetry import export as _export
+        _export.maybe_start(run_id=getattr(registry, "run_id", None))
         self.queue: List[Request] = []
         self.slots: List[Optional[_Slot]] = [None] * engine.decode_width
         self.results: Dict[str, ServedResult] = {}
@@ -266,6 +271,13 @@ class ContinuousBatcher:
                 if self._slot_done(s, tok):
                     self._finish(s, w)
         self._step_idx += 1
+        if self.registry is not None and getattr(self.registry, "enabled",
+                                                 False):
+            # serve.* gauges refreshed every scheduler step (host
+            # arithmetic over the ledger's perf_counter accounting), so
+            # the registry's next flush — and the live scrape riding it
+            # — always carries the current latency/shed picture
+            self.ledger.observe(self.registry)
 
     @property
     def active(self) -> int:

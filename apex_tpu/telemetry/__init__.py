@@ -1,6 +1,6 @@
 """apex_tpu.telemetry — training-telemetry subsystem.
 
-Eight pieces (see docs/telemetry.md):
+Ten pieces (see docs/telemetry.md):
 
   * :mod:`registry`  — counters/gauges/histograms/meters with a
     host-sync-batching ``step()`` context, rank-0-gated JSONL emission
@@ -37,10 +37,21 @@ Eight pieces (see docs/telemetry.md):
     ``goodput.fraction``/``badput.*`` gauges through the batched
     flush and as the ``GOODPUT.json`` run artifact the guard writes on
     exit/preempt/crash;
+  * :mod:`fleet`     — N per-host run dirs merged into one
+    writer-validated ``FLEET.json``: interval-union fleet goodput with
+    every host's per-class partition re-asserted, cross-host step skew,
+    leave-one-out host straggler z-scores (timeline's estimator),
+    control-action/flight-dump correlation, and an N-way merged Chrome
+    doc (one lane group per host on a shared epoch);
+  * :mod:`export`    — live pull-based OpenMetrics endpoint
+    (``APEX_TPU_METRICS_PORT`` gated, 127.0.0.1, default off) serving
+    the snapshot each ``Registry.flush`` resolves — zero extra host
+    syncs, a true no-op when disabled;
   * :mod:`report`    — JSONL → step-metrics summary +
     ``python -m apex_tpu.telemetry`` CLI (``trace <file>`` renders the
     span-timeline summary, ``mem`` the peak-HBM table, ``timeline``
-    the per-device step decomposition, ``goodput`` the run ledger).
+    the per-device step decomposition, ``goodput`` the run ledger,
+    ``fleet`` the merged multi-host view).
 
 The reference has no counterpart: its observability is rank-0 prints
 and an ``AverageMeter`` whose docstring warns that printing costs an
@@ -56,6 +67,8 @@ from . import events
 from . import memory
 from . import timeline
 from . import goodput
+from . import fleet
+from . import export
 from .registry import (SCHEMA, Registry, Counter, Gauge, Histogram,
                        AverageMeter, Throughput, JsonlSink, MemorySink,
                        NULL_METRIC, record_violations, records_violations)
@@ -67,9 +80,12 @@ from .trace import (Tracer, FlightRecorder, SlowStepSentinel, NULL_SPAN,
 from .memory import (MemoryMonitor, memory_table, memory_model,
                      format_memory_table)
 from .goodput import GoodputLedger, goodput_violations, FAULT_BADPUT
+from .fleet import build_fleet, fleet_violations
+from .export import MetricsExporter
 
 __all__ = [
     "trace", "registry", "events", "memory", "timeline", "goodput",
+    "fleet", "export",
     "SCHEMA",
     "Registry",
     "Counter", "Gauge",
@@ -82,4 +98,5 @@ __all__ = [
     "MemoryMonitor", "memory_table", "memory_model",
     "format_memory_table",
     "GoodputLedger", "goodput_violations", "FAULT_BADPUT",
+    "build_fleet", "fleet_violations", "MetricsExporter",
 ]

@@ -12,7 +12,11 @@ decomposition (compute / comm / exposed-comm / idle ms + straggler
 skew) from a device trace; ``python -m apex_tpu.telemetry goodput
 <jsonl|run-dir>`` renders the run-level goodput ledger (wall-clock
 badput attribution) from a ``GOODPUT.json`` artifact or a run's
-exported gauges.  See ``report.main`` for the flags."""
+exported gauges; ``python -m apex_tpu.telemetry fleet <dir> [dir...]``
+merges N per-host run dirs into the one-fleet view (goodput by host,
+step skew, stragglers, control actions) and can write the
+``FLEET.json`` artifact + N-way merged timeline.  See ``report.main``
+for the flags."""
 from .report import main
 
 if __name__ == "__main__":

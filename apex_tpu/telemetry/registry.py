@@ -55,6 +55,11 @@ try:                        # the goodput ledger too: flush exports the
 except ImportError:                    # standalone load never flushes
     _goodput = None
 
+try:                        # the live OpenMetrics exporter snapshots
+    from . import export as _export    # the flush's resolved records;
+except ImportError:                    # the standalone load never
+    _export = None                     # flushes
+
 # ---------------------------------------------------------------------------
 # record schema (the committed JSONL contract)
 # ---------------------------------------------------------------------------
@@ -442,9 +447,18 @@ class Registry:
 
     def __init__(self, *, sink=None, enabled: Optional[bool] = None,
                  flush_interval: int = 1, rank0_only: bool = True,
-                 run_id: Optional[str] = None, memory=None, goodput=None):
+                 run_id: Optional[str] = None, memory=None, goodput=None,
+                 exporter=None):
         self.enabled = _env_enabled() if enabled is None else bool(enabled)
         self.sink = sink
+        # live OpenMetrics export (docs/telemetry.md Fleet view + live
+        # export): ``exporter`` pins a telemetry.export.MetricsExporter,
+        # None consults the process-installed one at each flush (the
+        # guard arms it when APEX_TPU_METRICS_PORT is set), False
+        # switches the snapshot off.  The snapshot copies the flush's
+        # already-resolved records — no sync, and with no exporter
+        # installed the cost is one module-default check per flush.
+        self._exporter = exporter
         # run-level goodput gauges (docs/telemetry.md Goodput ledger):
         # ``goodput`` pins a telemetry.goodput.GoodputLedger, None
         # consults the process-installed ledger at each flush (the
@@ -623,6 +637,14 @@ class Registry:
                             for k, v in ev["fields"].items()}
             records.append(ev)
         self._events = []
+        if records and self._exporter is not False and _export is not None:
+            exp = (self._exporter if self._exporter is not None
+                   else _export.get_exporter())
+            if exp is not None:
+                # the live scrape snapshot: the SAME resolved records
+                # this flush just built, copied under the exporter's
+                # lock — inside the batched window, zero extra syncs
+                exp.observe_flush(self, records)
         if records:
             _trace.note_flush(self._step, records)
         if self.sink is not None and records and self._emit_allowed():

@@ -390,6 +390,13 @@ def main(argv=None) -> int:
         # acted/suppressed/failed decision (apex_tpu.control)
         from ..control import ledger as _control_ledger
         return _control_ledger.cli(argv[1:])
+    if argv and argv[0] == "fleet":
+        # `python -m apex_tpu.telemetry fleet <dir> [dir...]`: merge N
+        # per-host run dirs into the one-fleet view (goodput by host,
+        # cross-host skew, stragglers, control actions, flight dumps)
+        # with --json/--out for FLEET.json + the merged timeline
+        from . import fleet as _fleet
+        return _fleet.cli(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.telemetry",

@@ -683,3 +683,50 @@ def test_chaos_midaction_preempt_resumes_with_acted_config(tmp_path):
     _, _, meta2 = CheckpointManager(str(tmp_path / "ck")).load_latest(
         with_meta=True)
     assert meta2[META_CONTROL_KEY]["live_collective"].startswith("bf16")
+
+
+def test_loss_window_signals_plateau_streak_and_noise_proxy():
+    """ISSUE 20 satellite: ``plateau_windows`` / ``grad_noise_proxy``
+    from the window's already-resolved losses — streak extends on
+    sub-threshold improvement, resets on real improvement, the noise
+    proxy is the sample std over |mean|, non-finite losses are
+    dropped, and everything is signals-only (no decision rows, no
+    actuator)."""
+    reg = Registry(sink=MemorySink(), flush_interval=0, rank0_only=False)
+    ctl = RunController(ControlConfig(enabled=True), registry=reg)
+
+    # window 1: no prior mean -> noise proxy only, no plateau signal
+    assert ctl.on_window(step=2, losses=[4.0, 6.0]) == []
+    # window 2: mean 5.0 -> 5.0, zero improvement -> streak 1
+    ctl.on_window(step=4, losses=[5.0, 5.0])
+    # window 3: mean halves -> real improvement resets the streak
+    ctl.on_window(step=6, losses=[2.5])
+    # window 4: NaN/inf/None are dropped; the rest plateau again
+    ctl.on_window(step=8, losses=[float("nan"), float("inf"), None, 2.5])
+
+    gauges = {}
+    for r in reg.flush():
+        if r.get("kind") == "metric" and r.get("type") == "gauge" \
+                and r["name"].startswith("loss."):
+            gauges[r["name"]] = r["value"]
+    assert gauges["loss.plateau_windows"] == 1.0     # last window's streak
+    # window 1's proxy: std([4, 6]) / 5 = sqrt(2)/5
+    assert ctl._plateau_windows == 1
+    assert ctl._loss_prev_mean == 2.5
+    reg.close()
+
+    # the streak accumulates across consecutive flat windows
+    ctl2 = RunController(ControlConfig(enabled=True))
+    ctl2.on_window(step=2, losses=[1.0])
+    for w in range(3):
+        ctl2.on_window(step=4 + 2 * w, losses=[1.0])
+    assert ctl2._plateau_windows == 3
+    # an all-garbage window leaves state untouched (no false reset)
+    ctl2.on_window(step=12, losses=[float("nan")])
+    assert ctl2._plateau_windows == 3
+    assert ctl2._loss_prev_mean == 1.0
+
+    # disabled controller: true no-op
+    off = RunController(ControlConfig(enabled=False))
+    assert off.on_window(step=2, losses=[1.0]) == []
+    assert off._loss_prev_mean is None

@@ -1,0 +1,280 @@
+"""``apex_tpu.telemetry.export`` (ISSUE 20): live OpenMetrics export
+of the registry's flush window.
+
+The contract under test:
+
+  * the exposition format is pinned (types, counter ``_total``,
+    histogram stat series, name sanitization, ``# EOF`` terminator);
+  * a live scrape mid-run returns THE SAME values the JSONL stream
+    recorded for that flush window — the exporter is a copy of the
+    flush, not a second measurement;
+  * zero new host syncs: the ``jax.device_get`` count per flush is
+    identical with the exporter on and off (the snapshot rides the
+    flush's existing batched window);
+  * disabled mode is a true no-op — no exporter object, no thread, no
+    env read beyond ``maybe_start``;
+  * ``APEX_TPU_METRICS_PORT`` gating + ``maybe_start`` idempotency;
+  * TrainGuard arms the process default around a run and records the
+    URL in its report, then tears it down.
+"""
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.resilience import GuardConfig, TrainGuard
+from apex_tpu.telemetry import JsonlSink, Registry, export
+from apex_tpu.telemetry import events as events_mod
+from apex_tpu.telemetry import trace as trace_mod
+from apex_tpu.telemetry.export import MetricsExporter, render_openmetrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv(export.ENV_PORT, raising=False)
+    prev_exp = export.install(None)
+    prev_reg = events_mod.set_default(None)
+    prev_tr = trace_mod.set_tracer(None)
+    yield
+    export.shutdown()            # close anything a test armed
+    export.install(prev_exp)
+    events_mod.set_default(prev_reg)
+    trace_mod.set_tracer(prev_tr)
+
+
+def _samples(text):
+    """name (incl. any label suffix) -> value string, sample lines only."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        out[name] = val
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the exposition format (pure function)
+# ---------------------------------------------------------------------------
+
+def test_render_openmetrics_format():
+    snap = {
+        "loss": {"type": "gauge", "value": 1.5},
+        "examples": {"type": "counter", "value": 32},
+        "serve.queue_depth": {"type": "gauge", "value": 3},
+        "step_time_ms": {"type": "histogram",
+                         "stats": {"count": 2, "sum": 10.0, "min": 4.0,
+                                   "max": 6.0, "mean": 5.0}},
+    }
+    text = render_openmetrics(snap, {"run": "r1", "step": 8,
+                                     "flushes": 4}, {"resumed": 2})
+    assert text.endswith("# EOF\n")
+    s = _samples(text)
+    assert s['apex_tpu_build_info{run="r1"}'] == "1"
+    assert s["apex_tpu_last_flush_step"] == "8"
+    assert s["apex_tpu_flushes"] == "4"
+    assert s["apex_tpu_loss"] == "1.5"
+    # counters get the _total suffix and the counter type line
+    assert s["apex_tpu_examples_total"] == "32"
+    assert "# TYPE apex_tpu_examples_total counter" in text
+    assert "# TYPE apex_tpu_loss gauge" in text
+    # dots sanitize to underscores
+    assert s["apex_tpu_serve_queue_depth"] == "3"
+    # histograms expand to the five stat series
+    for stat, v in (("count", "2"), ("sum", "10"), ("min", "4"),
+                    ("max", "6"), ("mean", "5")):
+        assert s[f"apex_tpu_step_time_ms_{stat}"] == v
+    assert s['apex_tpu_events_total{name="resumed"}'] == "2"
+
+
+def test_env_port_parsing(monkeypatch):
+    assert export.env_port() is None                  # unset
+    for bad in ("", "  ", "nope", "-1", "70000", "8.5"):
+        monkeypatch.setenv(export.ENV_PORT, bad)
+        assert export.env_port() is None, bad
+    monkeypatch.setenv(export.ENV_PORT, "0")          # ephemeral is real
+    assert export.env_port() == 0
+    monkeypatch.setenv(export.ENV_PORT, " 9101 ")
+    assert export.env_port() == 9101
+
+
+# ---------------------------------------------------------------------------
+# live scrape == the JSONL flush window
+# ---------------------------------------------------------------------------
+
+def test_live_scrape_matches_jsonl_flush_window(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    with MetricsExporter(port=0, run_id="scrape-run") as exp:
+        reg = Registry(sink=JsonlSink(str(path)), flush_interval=2,
+                       rank0_only=False, run_id="scrape-run",
+                       exporter=exp)
+        for i in range(4):
+            with reg.step():
+                reg.gauge("loss").set(2.0 - 0.25 * i)
+                reg.counter("examples").add(8)
+            if i == 1:
+                reg.event("resumed", step=2)   # drains at the next flush
+        with urllib.request.urlopen(exp.url, timeout=5) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            body = resp.read().decode()
+        reg.close()
+    s = _samples(body)
+    # the scrape IS the last flush window the JSONL recorded
+    recs = [json.loads(ln) for ln in path.read_text().splitlines()]
+    last_loss = [r for r in recs if r.get("name") == "loss"][-1]
+    assert float(s["apex_tpu_loss"]) == last_loss["value"]
+    last_hist = [r for r in recs if r.get("name") == "step_time_ms"
+                 and (r.get("stats") or {}).get("count")][-1]["stats"]
+    assert float(s["apex_tpu_step_time_ms_count"]) == last_hist["count"]
+    assert float(s["apex_tpu_step_time_ms_mean"]) == pytest.approx(
+        last_hist["mean"])
+    assert s['apex_tpu_build_info{run="scrape-run"}'] == "1"
+    assert s["apex_tpu_last_flush_step"] == "4"
+    assert s['apex_tpu_events_total{name="resumed"}'] == "1"
+    # the /json view carries the same snapshot
+    with MetricsExporter(port=0) as e2:
+        e2.observe_flush(None, [{"kind": "metric", "ts": "t", "step": 1,
+                                 "name": "x", "type": "gauge",
+                                 "value": 7.0}])
+        with urllib.request.urlopen(
+                e2.url.replace("/metrics", "/json"), timeout=5) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["metrics"]["x"]["value"] == 7.0
+        # unknown paths 404 instead of leaking
+        bad = e2.url.replace("/metrics", "/nope")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad, timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# zero new host syncs
+# ---------------------------------------------------------------------------
+
+def _drive(reg):
+    for i in range(4):
+        with reg.step():
+            # device values: the flush's batched window must resolve
+            reg.gauge("loss").set(jnp.float32(i))
+            reg.counter("examples").add(4)
+    reg.close()
+
+
+def test_exporter_adds_zero_device_gets(monkeypatch):
+    """The flush's batched window already pays its one ``device_get``;
+    the exporter must not add another."""
+    counts = []
+    real_get = jax.device_get
+
+    def run(exporter):
+        calls = [0]
+        monkeypatch.setattr(
+            jax, "device_get",
+            lambda x: (calls.__setitem__(0, calls[0] + 1),
+                       real_get(x))[1])
+        reg = Registry(flush_interval=2, rank0_only=False,
+                       exporter=exporter)
+        _drive(reg)
+        monkeypatch.setattr(jax, "device_get", real_get)
+        counts.append(calls[0])
+
+    exp = MetricsExporter(port=0)          # unstarted: pure snapshot
+    run(exp)
+    run(False)                             # hard opt-out
+    assert counts[0] == counts[1]
+    assert counts[0] > 0                   # the harness saw real flushes
+    # and the snapshot actually landed while costing nothing extra
+    assert exp._snapshot["loss"]["value"] == 3.0
+    assert exp._meta["flushes"] >= 2
+
+
+def test_disabled_mode_is_a_true_noop(monkeypatch):
+    monkeypatch.delenv(export.ENV_PORT, raising=False)
+    before = {t.name for t in threading.enumerate()}
+    assert export.maybe_start(run_id="r") is None
+    assert export.get_exporter() is None
+    reg = Registry(flush_interval=2, rank0_only=False)
+    _drive(reg)
+    assert export.get_exporter() is None
+    after = {t.name for t in threading.enumerate()}
+    assert "apex-tpu-metrics" not in after - before
+
+
+def test_registry_exporter_false_opts_out_of_the_default():
+    """``exporter=False`` bypasses even an installed process default —
+    a registry can opt out of a fleet-armed endpoint."""
+    exp = MetricsExporter(port=0)
+    export.install(exp)
+    reg = Registry(flush_interval=2, rank0_only=False, exporter=False)
+    _drive(reg)
+    assert exp._snapshot == {}
+    # and the default DOES receive flushes from a registry that didn't
+    reg2 = Registry(flush_interval=2, rank0_only=False)
+    _drive(reg2)
+    assert exp._snapshot["loss"]["value"] == 3.0
+    export.install(None)
+
+
+def test_maybe_start_idempotent_and_shutdown(monkeypatch):
+    monkeypatch.setenv(export.ENV_PORT, "0")
+    e1 = export.maybe_start(run_id="first")
+    assert e1 is not None and e1.port is not None
+    assert e1.url == f"http://127.0.0.1:{e1.port}/metrics"
+    e2 = export.maybe_start(run_id="second")
+    assert e2 is e1                        # one endpoint per process
+    assert e1._meta["run"] == "second"     # identity refreshed
+    export.shutdown()
+    assert export.get_exporter() is None
+    assert e1.port is None                 # socket released
+
+
+# ---------------------------------------------------------------------------
+# TrainGuard integration: armed around the run, URL in the report
+# ---------------------------------------------------------------------------
+
+def _sgd_step():
+    @jax.jit
+    def step(w, batch):
+        g = jax.grad(lambda w: jnp.sum((w - batch) ** 2))(w)
+        return w - 0.1 * g, jnp.sum((w - batch) ** 2)
+    return step
+
+
+def test_guard_arms_export_and_reports_url(tmp_path, monkeypatch):
+    monkeypatch.setenv(export.ENV_PORT, "0")
+    urls = []
+
+    def batches(i):
+        exp = export.get_exporter()
+        if exp is not None and exp.url:
+            urls.append(exp.url)           # live DURING the run
+        return jnp.asarray(np.random.RandomState(i).randn(4).astype(
+            np.float32))
+
+    cfg = GuardConfig(ckpt_dir=str(tmp_path / "ck"), save_every_steps=4,
+                      check_every=2, backoff_seconds=0.01, enabled=True)
+    _, rep = TrainGuard(_sgd_step(), cfg).run(jnp.zeros(4), batches, 6)
+    assert rep.status == "completed"
+    assert rep.export_url is not None
+    assert rep.export_url.startswith("http://127.0.0.1:")
+    assert urls and urls[0] == rep.export_url
+    # guard owns what it armed: torn down after the run
+    assert export.get_exporter() is None
+
+
+def test_guard_without_env_reports_no_url(tmp_path, monkeypatch):
+    monkeypatch.delenv(export.ENV_PORT, raising=False)
+    cfg = GuardConfig(ckpt_dir=str(tmp_path / "ck"), save_every_steps=4,
+                      check_every=2, backoff_seconds=0.01, enabled=True)
+    _, rep = TrainGuard(_sgd_step(), cfg).run(
+        jnp.zeros(4),
+        lambda i: jnp.asarray(
+            np.random.RandomState(i).randn(4).astype(np.float32)), 4)
+    assert rep.status == "completed"
+    assert rep.export_url is None
+    assert export.get_exporter() is None

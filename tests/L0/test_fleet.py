@@ -1,0 +1,559 @@
+"""``apex_tpu.telemetry.fleet`` (ISSUE 20): per-host run dirs merged
+into one writer-validated ``FLEET.json``.
+
+What is proven here:
+
+  * the fleet goodput block: the wall union is the EXACT interval
+    union (overlapping host windows counted once, disjoint windows
+    summing), the per-class partition is preserved at both levels, and
+    a host whose artifact fails its OWN partition fails the merge —
+    the fleet view never launders torn books;
+  * degradation: any subset of artifacts per host (goodput-only, torn
+    JSONL tail, completely empty dir) merges without failing the
+    fleet;
+  * the 1-host fleet is the degenerate case: its per-host summary IS
+    ``report.summarize`` over the same records, exactly;
+  * cross-host signals: stragglers are named through
+    ``timeline.straggler_rows`` with hosts standing in as devices,
+    step-boundary skew comes from the flush timestamps;
+  * control decisions and flight dumps correlate across hosts — every
+    row names the host that acted/dumped;
+  * the N-way Chrome merge: one pid lane group per host, rebased onto
+    the shared fleet epoch;
+  * THE chaos acceptance: two guard-driven runs (one clean, one under
+    ``straggler@N:F`` with the control quarantine) merge into a
+    schema-valid FLEET.json whose per-host partitions are exact, whose
+    straggler section names the injected host, and whose control
+    section carries the acted quarantine;
+  * the controller's loss-window signals (``loss.plateau_windows`` /
+    ``loss.grad_noise_proxy``) stream as gauges into the per-host
+    ``loss`` block.
+"""
+import calendar
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.control import ControlConfig, RunController
+from apex_tpu.control import ledger as ctl_ledger
+from apex_tpu.resilience import GuardConfig, TrainGuard, faults
+from apex_tpu.telemetry import JsonlSink, Registry, fleet, goodput
+from apex_tpu.telemetry import events as events_mod
+from apex_tpu.telemetry import trace as trace_mod
+from apex_tpu.telemetry.report import load_records, summarize
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv("APEX_TPU_METRICS_PORT", raising=False)
+    prev_tr = trace_mod.set_tracer(None)
+    prev_reg = events_mod.set_default(None)
+    prev_led = goodput.install(None)
+    prev_plan = faults.install(None)
+    yield
+    trace_mod.set_tracer(prev_tr)
+    events_mod.set_default(prev_reg)
+    goodput.install(prev_led)
+    faults.install(prev_plan)
+
+
+# ---------------------------------------------------------------------------
+# synthetic run-dir builders
+# ---------------------------------------------------------------------------
+
+EPOCH = calendar.timegm(time.strptime("2026-08-07T10:00:00Z",
+                                      "%Y-%m-%dT%H:%M:%SZ"))
+
+
+def _ts_at(epoch):
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(epoch))
+
+
+def _gdoc(wall_ms, productive_ms, end_epoch, steps=5):
+    """A schema-valid GOODPUT.json: productive + idle partition the
+    wall exactly, written as of ``end_epoch``."""
+    idle_ms = wall_ms - productive_ms
+    classes = {}
+    for c in fleet.GOODPUT_CLASSES:
+        ms = {"productive": productive_ms, "idle": idle_ms}.get(c, 0.0)
+        classes[c] = {"ms": round(float(ms), 6),
+                      "fraction": round(ms / wall_ms, 6) if wall_ms
+                      else 0.0}
+    doc = {"kind": "goodput_ledger", "version": 1,
+           "ts": _ts_at(end_epoch), "wall_ms": float(wall_ms),
+           "goodput_fraction": classes["productive"]["fraction"],
+           "classes": classes, "partition_error_ms": 0.0,
+           "steps": steps, "replayed_steps": 0,
+           "counts": {"rollbacks": 0}, "dropped_intervals": 0}
+    assert goodput.goodput_violations(doc) == []
+    return doc
+
+
+def _host_dir(tmp_path, name, gdoc=None, records=None, raw_tail=None):
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    if gdoc is not None:
+        (d / "GOODPUT.json").write_text(json.dumps(gdoc))
+    if records is not None or raw_tail is not None:
+        lines = [json.dumps(r) for r in (records or [])]
+        if raw_tail is not None:
+            lines.append(raw_tail)          # torn tail: no newline fix-up
+        (d / "telemetry.jsonl").write_text("\n".join(lines))
+    return str(d)
+
+
+def _hist(step, mean_ms, epoch):
+    return {"kind": "metric", "ts": _ts_at(epoch), "step": int(step),
+            "name": "step_time_ms", "type": "histogram",
+            "stats": {"count": 1, "sum": float(mean_ms),
+                      "min": float(mean_ms), "max": float(mean_ms),
+                      "mean": float(mean_ms)}}
+
+
+# ---------------------------------------------------------------------------
+# fleet goodput: union / partition
+# ---------------------------------------------------------------------------
+
+def test_overlapping_windows_union_not_double_counted(tmp_path):
+    """Two hosts whose 10s walls overlap by 5s: the sum is 20s, the
+    union 15s — overlap is never counted twice."""
+    a = _host_dir(tmp_path, "a", _gdoc(10_000.0, 8_000.0, EPOCH + 10))
+    b = _host_dir(tmp_path, "b", _gdoc(10_000.0, 6_000.0, EPOCH + 15))
+    doc, _ = fleet.build_fleet([a, b])
+    assert fleet.fleet_violations(doc) == []
+    g = doc["goodput"]
+    assert g["wall_sum_ms"] == pytest.approx(20_000.0)
+    assert g["wall_union_ms"] == pytest.approx(15_000.0)
+    assert g["overlap_ms"] == pytest.approx(5_000.0)
+    # per-class sums across hosts, fractions over the summed wall
+    assert g["classes"]["productive"]["ms"] == pytest.approx(14_000.0)
+    assert g["goodput_fraction"] == pytest.approx(0.7)
+    assert g["classes"]["idle"]["ms"] == pytest.approx(6_000.0)
+    assert doc["n_hosts"] == 2 and doc["hosts"] == ["a", "b"]
+    for name in ("a", "b"):
+        entry = doc["per_host"][name]
+        assert entry["goodput_source"] == "artifact"
+        assert entry["partition_ok"] is True
+
+
+def test_disjoint_windows_union_equals_sum(tmp_path):
+    a = _host_dir(tmp_path, "a", _gdoc(10_000.0, 9_000.0, EPOCH + 10))
+    b = _host_dir(tmp_path, "b", _gdoc(10_000.0, 9_000.0, EPOCH + 30))
+    doc, _ = fleet.build_fleet([a, b])
+    g = doc["goodput"]
+    assert g["wall_union_ms"] == pytest.approx(g["wall_sum_ms"])
+    assert g["overlap_ms"] == pytest.approx(0.0)
+    # steps fold across hosts
+    assert g["steps"] == 10
+
+
+def test_torn_host_partition_fails_the_merge(tmp_path):
+    """A host artifact whose classes do NOT partition its wall must
+    fail the merge — and the auditor must catch the same tear in a
+    tampered written doc."""
+    good = _gdoc(10_000.0, 8_000.0, EPOCH + 10)
+    good["classes"]["productive"]["ms"] += 500.0     # tear the books
+    d = tmp_path / "a"
+    d.mkdir()
+    (d / "GOODPUT.json").write_text(json.dumps(good))
+    with pytest.raises(ValueError, match="partition"):
+        fleet.build_fleet([str(d)])
+    # the read-side auditor catches a post-write tamper too
+    a = _host_dir(tmp_path, "b", _gdoc(10_000.0, 8_000.0, EPOCH + 10))
+    doc, _ = fleet.build_fleet([a])
+    doc["per_host"]["b"]["goodput"]["classes"]["productive"]["ms"] += 500
+    assert any("torn" in v or "partition" in v
+               for v in fleet.fleet_violations(doc))
+
+
+def test_fleet_classes_sum_audited(tmp_path):
+    a = _host_dir(tmp_path, "a", _gdoc(10_000.0, 8_000.0, EPOCH + 10))
+    doc, _ = fleet.build_fleet([a])
+    doc["goodput"]["classes"]["idle"]["ms"] += 123.0
+    assert any("sum" in v for v in fleet.fleet_violations(doc))
+    # union exceeding the sum is double-counted overlap
+    doc2, _ = fleet.build_fleet([a])
+    doc2["goodput"]["wall_union_ms"] = doc2["goodput"]["wall_sum_ms"] + 9
+    assert any("overlap" in v for v in fleet.fleet_violations(doc2))
+
+
+# ---------------------------------------------------------------------------
+# degradation: any subset of artifacts per host
+# ---------------------------------------------------------------------------
+
+def test_degraded_hosts_merge_without_failing_the_fleet(tmp_path):
+    # host a: goodput artifact only — no JSONL, no summary
+    a = _host_dir(tmp_path, "a", _gdoc(5_000.0, 4_000.0, EPOCH + 5))
+    # host b: JSONL with a torn tail (killed mid-write) and no ledgers
+    b = _host_dir(tmp_path, "b",
+                  records=[_hist(2, 10.0, EPOCH + 2)],
+                  raw_tail='{"kind": "metric", "ts": "2026-08-0')
+    # host c: died before writing anything
+    c = tmp_path / "c"
+    c.mkdir()
+    doc, _ = fleet.build_fleet([a, b, str(c)])
+    assert fleet.fleet_violations(doc) == []
+    assert doc["n_hosts"] == 3
+    pa, pb, pc = (doc["per_host"][h] for h in ("a", "b", "c"))
+    assert pa["records"] == 0 and "summary" not in pa
+    assert pa["goodput_source"] == "artifact"
+    assert pb["records"] == 1                  # the torn line was skipped
+    assert pb["window"] is not None            # from the JSONL stamps
+    assert pc["records"] == 0 and pc["goodput"] is None
+    assert pc["window"] is None
+    # only host a contributes wall; the fleet stays consistent
+    assert doc["goodput"]["wall_sum_ms"] == pytest.approx(5_000.0)
+    # and the rendered table covers every host row
+    table = fleet.format_fleet(doc)
+    for h in ("a", "b", "c"):
+        assert h in table
+
+
+def test_one_host_fleet_reproduces_report_summarize(tmp_path):
+    """The degenerate 1-host fleet must agree with the single-run
+    tooling EXACTLY: per_host summary == report.summarize over the
+    same records."""
+    d = tmp_path / "solo"
+    d.mkdir()
+    path = d / "telemetry.jsonl"
+    reg = Registry(sink=JsonlSink(str(path)), flush_interval=2,
+                   rank0_only=False, run_id="solo-run")
+    for i in range(4):
+        with reg.step():
+            reg.gauge("loss").set(2.0 - 0.1 * i)
+            reg.counter("examples").add(8)
+    reg.event("resumed", step=2)
+    reg.close()
+    doc, _ = fleet.build_fleet([str(d)])
+    assert fleet.fleet_violations(doc) == []
+    assert doc["hosts"] == ["solo"]
+    expected = summarize(load_records(str(path)))
+    assert doc["per_host"]["solo"]["summary"] == expected
+    assert doc["per_host"]["solo"]["records"] == len(
+        load_records(str(path)))
+
+
+# ---------------------------------------------------------------------------
+# cross-host signals: stragglers + skew
+# ---------------------------------------------------------------------------
+
+def test_straggler_names_the_slow_host(tmp_path):
+    """4 hosts, one 5x slower on every shared step: the leave-one-out
+    z-score (timeline.straggler_rows, hosts as devices) names it."""
+    dirs = []
+    for h in range(4):
+        busy = 50.0 if h == 2 else 10.0
+        dirs.append(_host_dir(
+            tmp_path, f"h{h}",
+            records=[_hist(s, busy, EPOCH + s) for s in (2, 4, 6)]))
+    doc, _ = fleet.build_fleet(dirs)
+    st = doc["stragglers"]
+    assert st["named"] == "h2"
+    assert st["max_z"] >= 3.0
+    assert st["hosts"] == {"h2": 3}            # flagged on every step
+    assert all(r["host"] == "h2" and r["busy_ms"] == 50.0
+               for r in st["rows"])
+    assert doc["skew"]["steps_compared"] == 3
+    # a uniform fleet names nobody
+    uni = [_host_dir(tmp_path, f"u{h}",
+                     records=[_hist(2, 10.0, EPOCH)]) for h in range(3)]
+    doc2, _ = fleet.build_fleet(uni)
+    assert doc2["stragglers"]["named"] is None
+    assert doc2["stragglers"]["rows"] == []
+
+
+def test_skew_from_cross_host_flush_timestamps(tmp_path):
+    """The same step flushed 2s apart on two hosts reads as 2000ms of
+    step-boundary skew."""
+    a = _host_dir(tmp_path, "a", records=[_hist(2, 10.0, EPOCH + 1),
+                                          _hist(4, 10.0, EPOCH + 2)])
+    b = _host_dir(tmp_path, "b", records=[_hist(2, 10.0, EPOCH + 3),
+                                          _hist(4, 10.0, EPOCH + 4)])
+    doc, _ = fleet.build_fleet([a, b])
+    assert doc["skew"]["steps_compared"] == 2
+    assert doc["skew"]["max_skew_ms"] == pytest.approx(2_000.0)
+    assert doc["skew"]["mean_skew_ms"] == pytest.approx(2_000.0)
+
+
+# ---------------------------------------------------------------------------
+# control + flight correlation
+# ---------------------------------------------------------------------------
+
+def _control_doc():
+    pol = {"name": "gp_floor", "signal": "goodput_fraction", "lo": 0.5,
+           "hi": None, "k_consecutive": 1, "cooldown_windows": 0,
+           "action": "comm_retune"}
+    rows = [
+        {"window": 3, "step": 6, "policy": "gp_floor",
+         "signal": "goodput_fraction", "value": 0.3, "lo": 0.5,
+         "hi": None, "action": "comm_retune", "outcome": "acted",
+         "detail": {"to": "bf16"}},
+        {"window": 5, "step": 10, "policy": "gp_floor",
+         "signal": "goodput_fraction", "value": 0.2, "lo": 0.5,
+         "hi": None, "action": "comm_retune",
+         "outcome": "suppressed_cooldown", "detail": {}},
+    ]
+    return ctl_ledger.build_doc(enabled=True, windows=6, max_actions=2,
+                                policies=[pol], decisions=rows,
+                                status="completed")
+
+
+def test_control_decisions_and_flights_carry_their_host(tmp_path):
+    a = tmp_path / "a"
+    a.mkdir()
+    ctl_ledger.write_doc(_control_doc(), directory=str(a))
+    b = tmp_path / "b"
+    b.mkdir()
+    (b / "flight-oom-000012.json").write_text(json.dumps(
+        {"reason": "oom", "step": 12, "ts": _ts_at(EPOCH + 7)}))
+    (b / "flight-crash-000020.json").write_text('{"reason": "cra')  # torn
+    doc, _ = fleet.build_fleet([str(a), str(b)])
+    assert fleet.fleet_violations(doc) == []
+    ctl = doc["control"]
+    assert ctl["actions_fired"] == 1 and ctl["suppressed"] == 1
+    assert [d["host"] for d in ctl["decisions"]] == ["a", "a"]
+    assert [d["window"] for d in ctl["decisions"]] == [3, 5]  # sorted
+    assert doc["per_host"]["a"]["control_decisions"] == 2
+    assert doc["per_host"]["b"]["control_decisions"] is None
+    flights = doc["flights"]
+    assert len(flights) == 2
+    by_reason = {f["reason"]: f for f in flights}
+    assert by_reason["oom"]["host"] == "b"
+    assert by_reason["oom"]["step"] == 12
+    assert by_reason["crash"].get("torn") is True    # from the filename
+    # a tampered decision row (host stripped) fails the audit
+    doc["control"]["decisions"][0].pop("host")
+    assert any("host" in v for v in fleet.fleet_violations(doc))
+
+
+# ---------------------------------------------------------------------------
+# the N-way Chrome merge
+# ---------------------------------------------------------------------------
+
+def test_merge_host_timelines_lane_groups_and_rebase():
+    ev_a = [{"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "host"}},
+            {"ph": "X", "name": "train.step", "ts": 1000.0, "dur": 50,
+             "pid": 7, "tid": 1, "args": {}}]
+    ev_b = [{"ph": "X", "name": "train.step", "ts": 400.0, "dur": 60,
+             "pid": 7, "tid": 1, "args": {}},
+            {"ph": "X", "name": "ckpt.save", "ts": 500.0, "dur": 10,
+             "pid": 9, "tid": 1, "args": {}}]
+    doc = fleet.merge_host_timelines(
+        {"a": ev_a, "b": ev_b}, {"a": 0.0, "b": 2_000.0})
+    evs = doc["traceEvents"]
+    metas = {e["args"]["name"]: e["pid"] for e in evs if e["ph"] == "M"}
+    # one lane group per (host, original pid); names carry the host
+    assert set(metas) == {"a:host", "b:pid7", "b:pid9"}
+    assert len(set(metas.values())) == 3       # pids never collide
+    rows = [e for e in evs if e["ph"] == "X"]
+    by = {(e["name"], e["pid"]): e for e in rows}
+    # host a's earliest event rebases to its offset (0); host b's to 2000
+    assert by[("train.step", metas["a:host"])]["ts"] == pytest.approx(0.0)
+    assert by[("train.step", metas["b:pid7"])]["ts"] == pytest.approx(
+        2_000.0)
+    assert by[("ckpt.save", metas["b:pid9"])]["ts"] == pytest.approx(
+        2_100.0)                                # relative spacing kept
+
+
+# ---------------------------------------------------------------------------
+# schema negatives + io/CLI round trip
+# ---------------------------------------------------------------------------
+
+def test_fleet_violations_negative_cases(tmp_path):
+    assert fleet.fleet_violations([]) != []
+    assert any("kind" in v for v in fleet.fleet_violations(
+        {"kind": "nope"}))
+    a = _host_dir(tmp_path, "a", _gdoc(1_000.0, 900.0, EPOCH + 1))
+    doc, _ = fleet.build_fleet([a])
+    assert fleet.fleet_violations(doc) == []
+    bad = json.loads(json.dumps(doc))
+    bad["n_hosts"] = 5
+    assert any("n_hosts" in v for v in fleet.fleet_violations(bad))
+    bad2 = json.loads(json.dumps(doc))
+    bad2["per_host"]["ghost"] = {}
+    assert any("per_host" in v for v in fleet.fleet_violations(bad2))
+    bad3 = json.loads(json.dumps(doc))
+    bad3["goodput"]["goodput_fraction"] = 0.123
+    assert any("goodput_fraction" in v
+               for v in fleet.fleet_violations(bad3))
+
+
+def test_write_load_cli_roundtrip(tmp_path, capsys):
+    a = _host_dir(tmp_path, "a", _gdoc(10_000.0, 8_000.0, EPOCH + 10))
+    b = _host_dir(tmp_path, "b", _gdoc(10_000.0, 7_000.0, EPOCH + 15))
+    # host a carries a trace capture -> the merged timeline has events
+    (tmp_path / "a" / "run.trace.json").write_text(json.dumps(
+        {"traceEvents": [{"ph": "X", "name": "train.step", "ts": 10.0,
+                          "dur": 5, "pid": 1, "tid": 1, "args": {}}]}))
+    out = tmp_path / "out"
+    out.mkdir()
+    rc = fleet.cli([a, b, "--out", str(out), "--json"])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "wrote" in printed
+    doc = fleet.load_artifact(str(out))        # dir form audits + loads
+    assert doc["n_hosts"] == 2
+    assert (out / fleet.TIMELINE_NAME).exists()
+    tl = json.loads((out / fleet.TIMELINE_NAME).read_text())
+    assert any(e.get("ph") == "X" for e in tl["traceEvents"])
+    # a single FLEET.json renders without re-merging
+    assert fleet.cli([str(out / fleet.ARTIFACT_NAME)]) == 0
+    assert "fleet view" in capsys.readouterr().out
+    # write_fleet refuses an off-schema doc; the CLI reports bad input
+    with pytest.raises(ValueError):
+        fleet.write_fleet({"kind": "fleet"}, str(out))
+    (tmp_path / "garbage.json").write_text("{not json")
+    assert fleet.cli([str(tmp_path / "garbage.json")]) == 1
+    # the report CLI dispatches the subcommand
+    from apex_tpu.telemetry import report as treport
+    assert treport.main(["fleet", str(out / fleet.ARTIFACT_NAME)]) == 0
+
+
+def test_duplicate_basenames_stay_apart(tmp_path):
+    a = tmp_path / "x" / "run"
+    b = tmp_path / "y" / "run"
+    a.mkdir(parents=True)
+    b.mkdir(parents=True)
+    (a / "GOODPUT.json").write_text(json.dumps(
+        _gdoc(1_000.0, 900.0, EPOCH + 1)))
+    (b / "GOODPUT.json").write_text(json.dumps(
+        _gdoc(1_000.0, 800.0, EPOCH + 2)))
+    doc, _ = fleet.build_fleet([str(a), str(b)])
+    assert doc["hosts"] == ["run", "run#2"]
+    assert fleet.fleet_violations(doc) == []
+
+
+# ---------------------------------------------------------------------------
+# controller loss-window signals -> per-host loss block (satellite)
+# ---------------------------------------------------------------------------
+
+def test_loss_window_signals_flow_into_fleet_loss_block(tmp_path):
+    d = tmp_path / "h"
+    d.mkdir()
+    reg = Registry(sink=JsonlSink(str(d / "telemetry.jsonl")),
+                   flush_interval=0, rank0_only=False)
+    ctl = RunController(ControlConfig(enabled=True), registry=reg)
+    ctl.on_window(step=2, losses=[2.0, 2.2, 1.8])
+    rows = ctl.on_window(step=4, losses=[2.0, 2.1, 1.9])  # no improvement
+    assert rows == []                          # signals only, no actuator
+    reg.close()
+    recs = load_records(str(d / "telemetry.jsonl"))
+    gz = {r["name"]: r["value"] for r in recs
+          if r.get("kind") == "metric" and r.get("type") == "gauge"}
+    assert gz["loss.plateau_windows"] == 1.0
+    # sample std of [2.0, 2.1, 1.9] over |mean 2.0|
+    assert gz["loss.grad_noise_proxy"] == pytest.approx(0.05)
+    doc, _ = fleet.build_fleet([str(d)])
+    assert doc["per_host"]["h"]["loss"]["loss.plateau_windows"] == 1.0
+    assert doc["per_host"]["h"]["loss"][
+        "loss.grad_noise_proxy"] == pytest.approx(0.05)
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance (ISSUE 20): two guard runs -> one fleet view
+# ---------------------------------------------------------------------------
+
+def _sgd_step():
+    @jax.jit
+    def step(w, batch):
+        g = jax.grad(lambda w: jnp.sum((w - batch) ** 2))(w)
+        return w - 0.1 * g, jnp.sum((w - batch) ** 2)
+    return step
+
+
+def _batch_at(i):
+    return jnp.asarray(np.random.RandomState(i).randn(4).astype(
+        np.float32))
+
+
+def _guarded_run(run_dir, *, plan=None, controller=None, steps=30):
+    """One guard-driven run whose artifacts land in ``run_dir``:
+    GOODPUT.json (+ CONTROL.json when a controller acts) via the flight
+    destination, and the JSONL gauge stream via a registry whose
+    step-time windows are bracketed by the batch callback — each fetch
+    closes the previous ``reg.step()`` window, so per-step host timing
+    (including an injected straggler's slowdown) streams to disk."""
+    os.makedirs(run_dir, exist_ok=True)
+    reg = Registry(sink=JsonlSink(os.path.join(run_dir,
+                                               "telemetry.jsonl")),
+                   flush_interval=2, rank0_only=False,
+                   run_id=os.path.basename(run_dir))
+    cm_box = [None]
+
+    def batches(i):
+        if cm_box[0] is not None:
+            cm_box[0].__exit__(None, None, None)
+        cm_box[0] = reg.step()
+        cm_box[0].__enter__()
+        return _batch_at(i)
+
+    tr = trace_mod.Tracer(enabled=True, flight_dir=run_dir)
+    prev = trace_mod.set_tracer(tr)
+    try:
+        cfg = GuardConfig(ckpt_dir=os.path.join(run_dir, "ck"),
+                          save_every_steps=4, check_every=2,
+                          backoff_seconds=0.01, enabled=True,
+                          world_size=8)
+        _, rep = TrainGuard(_sgd_step(), cfg, plan=plan, registry=reg,
+                            controller=controller).run(
+            jnp.zeros(4), batches, steps)
+    finally:
+        trace_mod.set_tracer(prev)
+        reg.close()
+    return rep
+
+
+def test_chaos_two_guard_runs_merge_into_one_fleet_view(tmp_path):
+    """Acceptance: a clean guarded run and a straggler+quarantine run
+    merge into a schema-valid FLEET.json — per-host goodput classes
+    each partition that host's wall EXACTLY, the straggler section
+    names the injected host, and the control section carries the acted
+    quarantine."""
+    clean_dir = str(tmp_path / "clean")
+    chaos_dir = str(tmp_path / "chaos")
+    rep_clean = _guarded_run(clean_dir)
+    assert rep_clean.status == "completed"
+    plan = faults.parse("straggler@2x40:10.0")
+    ctl = RunController(ControlConfig(enabled=True, max_actions=2))
+    rep_chaos = _guarded_run(chaos_dir, plan=plan, controller=ctl)
+    assert rep_chaos.status == "preempted"     # the synthesized resize
+    assert rep_chaos.resize_to == 7
+
+    doc, timeline = fleet.build_fleet([clean_dir, chaos_dir])
+    assert fleet.fleet_violations(doc) == []
+    assert doc["hosts"] == ["clean", "chaos"]
+    # both hosts' artifacts made it in, each partitioning its own wall
+    for h in ("clean", "chaos"):
+        entry = doc["per_host"][h]
+        assert entry["goodput_source"] == "artifact"
+        assert entry["partition_ok"] is True
+        good = entry["goodput"]
+        total = sum(good["classes"][c]["ms"]
+                    for c in fleet.GOODPUT_CLASSES)
+        assert abs(total - good["wall_ms"]) <= max(
+            1e-3, 1e-6 * good["wall_ms"])
+        assert entry["records"] > 0            # the JSONL stream landed
+    # the straggler section names the injected host
+    st = doc["stragglers"]
+    assert doc["skew"]["steps_compared"] >= 2
+    assert st["named"] == "chaos", st
+    assert st["max_z"] >= 3.0
+    # the control section carries the acted quarantine, host-tagged
+    q = [d for d in doc["control"]["decisions"]
+         if d["action"] == "quarantine" and d["outcome"] == "acted"]
+    assert len(q) == 1 and q[0]["host"] == "chaos"
+    assert q[0]["detail"]["to_world"] == 7
+    assert doc["control"]["actions_fired"] >= 1
+    assert doc["per_host"]["clean"]["control_decisions"] is None
+    # round trip: write, audit from disk, render
+    path = fleet.write_fleet(doc, str(tmp_path / "out"), timeline)
+    disk = fleet.load_artifact(path)
+    assert disk["stragglers"]["named"] == "chaos"
+    assert "quarantine" in fleet.format_fleet(disk)

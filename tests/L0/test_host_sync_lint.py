@@ -142,3 +142,21 @@ def test_sanctioned_files_exist():
     """A sanctioned path that no longer exists is stale lint config."""
     for rel in SANCTIONED | MEMSTATS_SANCTIONED:
         assert os.path.exists(os.path.join(PKG, rel)), rel
+
+
+def test_fleet_and_export_are_covered_with_no_waiver():
+    """ISSUE 20: the fleet merge and the live exporter promise ZERO
+    host syncs (the export snapshot rides the registry flush's batched
+    window; the fleet merge is pure file tooling).  They must be
+    walked by the lint — present on disk, NOT sanctioned, and free of
+    sync calls or waivers, so a future sync added to either fails
+    ``test_no_host_syncs_outside_sanctioned_modules`` immediately."""
+    for rel in (os.path.join("telemetry", "fleet.py"),
+                os.path.join("telemetry", "export.py")):
+        path = os.path.join(PKG, rel)
+        assert os.path.exists(path), rel
+        assert rel not in SANCTIONED, rel
+        text = open(path).read()
+        assert _WAIVER not in text, rel
+        assert not _SYNC_CALL.search(text), rel
+        assert not _MEMSTATS_CALL.search(text), rel

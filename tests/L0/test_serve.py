@@ -595,6 +595,8 @@ def test_decide_ignores_cpu_measured_serve_leg(eng_fp32):
     assert "serve_decode_batch" not in prof
 
 
+@pytest.mark.slow   # ~25s: the full measured serve A/B leg; the decide()
+# contract tests above keep the profile gating in tier-1
 def test_bench_serve_leg_end_to_end():
     """The real leg: ``bench.bench_serve`` on the CPU mesh — variants
     measured, audit clean, decide() persists a schema-valid profile."""

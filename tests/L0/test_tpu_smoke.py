@@ -10,6 +10,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -22,6 +24,8 @@ def _load_smoke():
     return mod
 
 
+@pytest.mark.slow   # ~48s: every kernel family interpret-compiles; the
+# three harness tests below keep the runner/JSON/exit contract in tier-1
 def test_all_checks_pass_tiny_interpret_mode():
     """Every kernel family compiles (interpret) and matches XLA at the
     tiny shapes — the full check set, in-process."""

@@ -63,6 +63,8 @@ def run_watch(tmp_path, env_extra, timeout=60):
            "APEX_WATCH_CONTROL_CMD": "",
            # and the bench-trend/goodput watchdog (stage 4b)
            "APEX_WATCH_TREND_CMD": "",
+           # and the fleet view merge (stage 4c)
+           "APEX_WATCH_FLEET_CMD": "",
            "PYTHONPATH": ROOT,
            "JAX_PLATFORMS": "cpu",
            **env_extra}
@@ -966,6 +968,59 @@ def test_bench_trend_stage_artifact_and_span(tmp_path):
     assert r4.returncode == 0
     assert not (tmp_path / "TREND_EMPTY.json").exists()
     assert not (tmp_path / "TREND_EMPTY.json.run").exists()
+
+
+def test_fleet_stage_skip_when_absent_artifact_and_span(tmp_path):
+    """ISSUE 20 satellite: the fleet-view merge runs as watch stage 4c
+    — skip-when-absent (no run dir on disk, no stage, no log line),
+    atomic .run->mv artifact, watch.fleet span, skip-when-complete,
+    and a failed merge leaves no truncated artifact.
+
+    The watcher appends the discovered run dirs to the command, so the
+    fake ends in ``#`` to swallow them."""
+    fake = json.dumps({"kind": "fleet", "version": 1, "n_hosts": 2})
+    marker = tmp_path / "fleet_calls"
+    base = {
+        "APEX_WATCH_PROBE_CMD": "true",
+        "APEX_WATCH_BENCH_CMD": f"echo '{COMPLETE_BENCH}'",
+        "APEX_WATCH_KERN_CMD": f"echo '{COMPLETE_KERN}'",
+        "APEX_WATCH_FLEET_CMD": f"echo run >> {marker}; echo '{fake}' #",
+    }
+    # window 1: neither default run dir exists -> the stage never fires
+    r0, log0 = run_watch(tmp_path, base)
+    assert r0.returncode == 0, (r0.stdout, r0.stderr, log0)
+    assert "fleet view done" not in log0
+    assert not marker.exists()
+    assert not (tmp_path / "FLEET_r5.json").exists()
+
+    # window 2: a guard run dir appeared -> merge runs, artifact lands
+    (tmp_path / "ckpt_guard_r5").mkdir()
+    r, log = run_watch(tmp_path, base)
+    assert r.returncode == 0, (r.stdout, r.stderr, log)
+    assert "fleet view done rc=0" in log
+    art = json.loads((tmp_path / "FLEET_r5.json").read_text())
+    assert art["kind"] == "fleet" and art["n_hosts"] == 2
+    assert not (tmp_path / "FLEET_r5.json.run").exists()
+    from apex_tpu.telemetry import trace as ttrace
+    names = [e["name"] for e in ttrace.load_chrome(str(
+        tmp_path / "WATCH_TRACE_r5.json"))]
+    assert "watch.fleet" in names
+
+    # window 3: artifact present -> skip-when-complete
+    r2, _ = run_watch(tmp_path, base)
+    assert r2.returncode == 0
+    assert marker.read_text().count("run") == 1
+
+    # a failed merge leaves neither artifact nor .run turd
+    r3, log3 = run_watch(tmp_path, {
+        **base,
+        "APEX_WATCH_FLEET_JSON": "FLEET_FAIL.json",
+        "APEX_WATCH_FLEET_CMD": "false #",
+    })
+    assert r3.returncode == 0
+    assert "fleet view done rc=1" in log3
+    assert not (tmp_path / "FLEET_FAIL.json").exists()
+    assert not (tmp_path / "FLEET_FAIL.json.run").exists()
 
 
 def test_stage_spans_record_failures_too(tmp_path):

@@ -3,8 +3,11 @@
 artifacts (ISSUE 15 satellite).
 
 Ingests the per-round bench artifacts (``BENCH_r*.json`` driver
-wrappers and ``BENCH_TPU_r*.json`` raw captures) plus any
-``GOODPUT*.json`` run ledgers, assembles per-leg metric series —
+wrappers and ``BENCH_TPU_r*.json`` raw captures), any
+``GOODPUT*.json`` run ledgers, and any ``FLEET*.json`` multi-host
+merges (``telemetry.fleet``: fleet goodput fraction + max straggler z
+become series keyed by host count, so fleet-level drift fails stage 4b
+the same way per-leg drift does), assembles per-leg metric series —
 step time, throughput, MFU, goodput fraction — keyed by the leg's
 config signature (model/batch/seq/layers: a config change starts a NEW
 series, it is not a regression), and flags the newest point in each
@@ -42,7 +45,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LOWER_BETTER = {"step_ms": True, "value_ms": True,
                  "images_per_sec": False, "sequences_per_sec": False,
                  "mfu_pct": False, "mfu_analytic_pct": False,
-                 "goodput_fraction": False}
+                 "goodput_fraction": False,
+                 "fleet_goodput_fraction": False,
+                 "fleet_max_straggler_z": True}
 
 _LEG_METRICS = ("step_ms", "images_per_sec", "sequences_per_sec",
                 "mfu_pct", "mfu_analytic_pct")
@@ -53,14 +58,20 @@ _SIG_FIELDS = ("model", "batch", "seq", "layers", "arch", "chips",
                "global_batch")
 
 
-def _goodput_schema():
+def _schema_module(name):
+    """File-load a telemetry module for its schema functions (no
+    package import, no jax — the apply_perf_results posture)."""
     import importlib.util
     spec = importlib.util.spec_from_file_location(
-        "_apex_tpu_telemetry_goodput",
-        os.path.join(REPO, "apex_tpu", "telemetry", "goodput.py"))
+        f"_apex_tpu_telemetry_{name}",
+        os.path.join(REPO, "apex_tpu", "telemetry", f"{name}.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _goodput_schema():
+    return _schema_module("goodput")
 
 
 def _load(path):
@@ -159,6 +170,8 @@ def main(argv=None) -> int:
                          "BENCH_r*.json + BENCH_TPU_r*.json")
     ap.add_argument("--goodput-glob", default="GOODPUT*.json",
                     help="goodput run-artifact glob")
+    ap.add_argument("--fleet-glob", default="FLEET*.json",
+                    help="fleet merge-artifact glob (telemetry.fleet)")
     ap.add_argument("--tolerance", type=float, default=0.25,
                     help="allowed fractional drift before flagging")
     ap.add_argument("--strict-cpu", action="store_true",
@@ -208,6 +221,43 @@ def main(argv=None) -> int:
         series.setdefault("goodput:artifact_fraction", []).append(
             {"round": name, "backend": "run", "metric":
              "goodput_fraction", "value": frac})
+
+    # fleet merge artifacts (telemetry.fleet): the host count is the
+    # series signature — a 2-host fleet and a 4-host fleet are
+    # different configurations, not a regression — and the points are
+    # the fleet goodput fraction + the worst straggler z, so a fleet
+    # that starts wasting wall-clock or growing a straggler fails the
+    # gate like any TPU-backed leg ("run"-backend, the goodput posture)
+    fl_paths = [p for p in sorted(_glob.glob(os.path.join(
+        args.dir, args.fleet_glob)))
+        if not os.path.basename(p).startswith("FLEET_TRACE")]
+    fl_docs = []
+    fl_schema = _schema_module("fleet") if fl_paths else None
+    for path in fl_paths:
+        doc = _load(path)
+        if not isinstance(doc, dict):
+            continue
+        name = os.path.basename(path)
+        bad = fl_schema.fleet_violations(doc)
+        ledger_violations.extend(f"{name}: {v}" for v in bad)
+        if bad:
+            continue
+        fl_docs.append((doc.get("ts") or "", name, doc))
+    for ts, name, doc in sorted(fl_docs, key=lambda t: (t[0], t[1])):
+        rounds.append(name)
+        sig = f"hosts={doc.get('n_hosts')}"
+        frac = (doc.get("goodput") or {}).get("goodput_fraction")
+        if _num(frac):
+            series.setdefault(f"fleet:goodput_fraction|run|{sig}",
+                              []).append(
+                {"round": name, "backend": "run",
+                 "metric": "fleet_goodput_fraction", "value": float(frac)})
+        z = (doc.get("stragglers") or {}).get("max_z")
+        if _num(z) and z > 0:
+            series.setdefault(f"fleet:max_straggler_z|run|{sig}",
+                              []).append(
+                {"round": name, "backend": "run",
+                 "metric": "fleet_max_straggler_z", "value": float(z)})
 
     drifts = check_series(series, args.tolerance)
     gate = ("tpu", "run") if not args.strict_cpu else None

@@ -330,6 +330,59 @@ def check_control(tiny):
     return float(failed)
 
 
+def check_export(tiny):
+    """Live-export smoke (ISSUE 20): start a
+    ``telemetry.export.MetricsExporter`` on an ephemeral port, flush a
+    registry through it, scrape ``/metrics``, and shut it down clean —
+    the endpoint must serve a parseable OpenMetrics snapshot carrying
+    the flushed gauge value, and closing must join the daemon thread.
+    Value is the failure count (0.0 = bind, snapshot, scrape, parse,
+    shutdown all hold).  Host-only: no device work, same logic tiny
+    and production."""
+    import threading
+    import urllib.request
+    from apex_tpu.telemetry import MemorySink, Registry
+    from apex_tpu.telemetry import export as _export
+
+    failed = 0
+    threads_before = threading.active_count()
+    exp = _export.MetricsExporter(port=0, run_id="smoke").start()
+    try:
+        reg = Registry(sink=MemorySink(), enabled=True, flush_interval=0,
+                       exporter=exp)
+        reg.gauge("smoke.value").set(42.5)
+        reg.event("smoke.event", ok=1)
+        reg.flush()
+        body = urllib.request.urlopen(exp.url, timeout=10).read().decode()
+        lines = [ln for ln in body.splitlines() if ln.strip()]
+        if not lines or lines[-1] != "# EOF":
+            failed += 1
+        samples = {}
+        for ln in lines:
+            if ln.startswith("#"):
+                continue
+            parts = ln.rsplit(None, 1)
+            if len(parts) != 2:
+                failed += 1
+                break
+            try:
+                samples[parts[0]] = float(parts[1])
+            except ValueError:
+                failed += 1
+                break
+        if samples.get("apex_tpu_smoke_value") != 42.5:
+            failed += 1
+        if samples.get('apex_tpu_events_total{name="smoke_event"}') != 1:
+            failed += 1
+    except Exception:
+        failed += 1
+    finally:
+        exp.close()
+    if threading.active_count() > threads_before:
+        failed += 1   # the daemon thread must be joined, not leaked
+    return float(failed)
+
+
 # check name -> (fn, relative-error tolerance).  bf16 kernels compare
 # bf16-vs-bf16 math but accumulate differently (blocked f32 partials vs
 # one einsum), hence the looser flash tolerances.
@@ -353,6 +406,10 @@ CHECKS = {
     # not a numerics check: the value is the count of run-controller
     # contract failures (arm/gate/act/audit) — 0 required
     "control": (check_control, 0.5),
+    # not a numerics check: the value is the count of live-export
+    # contract failures (bind/snapshot/scrape/parse/shutdown) — 0
+    # required
+    "export": (check_export, 0.5),
 }
 
 

@@ -201,6 +201,17 @@ SERVE_TO=${APEX_WATCH_SERVE_TO:-400}
 TREND_CMD=${APEX_WATCH_TREND_CMD-"python tools/bench_trend.py --json"}
 TREND_JSON=${APEX_WATCH_TREND_JSON:-BENCH_TREND_r5.json}
 TREND_TO=${APEX_WATCH_TREND_TO:-120}
+# stage 4c: fleet view (ISSUE 20) — merge whatever run dirs stages 2-3
+# left behind this window (the guard ckpt dirs carry GOODPUT/CONTROL/
+# flight artifacts on the flight-destination chain) into one
+# schema-valid FLEET doc via the fleet CLI.  Skip-when-absent: no run
+# dir on disk, no stage.  The artifact feeds bench_trend's FLEET*.json
+# series next round (fleet goodput + straggler z drift).
+# ${VAR-default}: an explicitly EMPTY override disables the stage
+FLEET_CMD=${APEX_WATCH_FLEET_CMD-"python -m apex_tpu.telemetry fleet --json"}
+FLEET_DIRS=${APEX_WATCH_FLEET_DIRS:-"ckpt_guard_r5 ckpt_watch_r5"}
+FLEET_JSON=${APEX_WATCH_FLEET_JSON:-FLEET_r5.json}
+FLEET_TO=${APEX_WATCH_FLEET_TO:-120}
 INTEROP_CMD=${APEX_WATCH_INTEROP_CMD:-"python tools/bench_interop.py"}
 INTEROP_JSON=${APEX_WATCH_INTEROP_JSON:-INTEROP_r5.json}
 INTEROP_TO=${APEX_WATCH_INTEROP_TO:-600}
@@ -227,7 +238,10 @@ stage_span() {  # $1: stage name, $2: t0 (us), $3: rc
 # rendered trace shows an HBM curve point after every capture stage
 # (docs/telemetry.md Memory).  Best-effort: an unsupported backend or
 # a wedged tunnel (the timeout bounds the dial) appends nothing.
-MEM_CMD=${APEX_WATCH_MEM_CMD:-'python -c "from apex_tpu.telemetry.memory import device_memory_json as j; print(j())"'}
+# ${VAR-default}: an explicitly EMPTY override disables the sampler
+# (the [ -n ] guard in stage_mem) — with ":-" an empty override would
+# silently re-enable the default's jax import on every stage.
+MEM_CMD=${APEX_WATCH_MEM_CMD-'python -c "from apex_tpu.telemetry.memory import device_memory_json as j; print(j())"'}
 MEM_TO=${APEX_WATCH_MEM_TO:-30}
 stage_mem() {  # no args: sample the device allocator now
   [ -n "$MEM_CMD" ] || return 0
@@ -563,6 +577,26 @@ for i in $(seq 1 "$N_PROBES"); do
         rm -f "$TREND_JSON".run
       fi
       echo "$(date +%H:%M:%S) bench trend watchdog done rc=$rcbt" >> "$LOG"
+    fi
+    # ---- stage 4c: fleet view over this window's run dirs ----
+    # skip-when-absent (no run dir on disk, no stage) + skip-when-
+    # complete + atomic .run->mv; a failed merge never leaves a
+    # truncated artifact
+    if [ -n "$FLEET_CMD" ] && [ ! -s "$FLEET_JSON" ]; then
+      fleet_dirs=""
+      for d in $FLEET_DIRS; do [ -d "$d" ] && fleet_dirs="$fleet_dirs $d"; done
+      if [ -n "$fleet_dirs" ]; then
+        t0=$(now_us)
+        timeout -k 10 "$FLEET_TO" bash -c "$FLEET_CMD$fleet_dirs" > "$FLEET_JSON".run 2>> "$LOG"
+        rcfv=$?   # capture BEFORE the $(date) substitution resets $?
+        stage_span fleet "$t0" "$rcfv"
+        if [ $rcfv -eq 0 ] && [ -s "$FLEET_JSON".run ]; then
+          mv "$FLEET_JSON".run "$FLEET_JSON"
+        else
+          rm -f "$FLEET_JSON".run
+        fi
+        echo "$(date +%H:%M:%S) fleet view done rc=$rcfv" >> "$LOG"
+      fi
     fi
     # ---- stage 5: interop bridge cost (best-effort; CPU-side meas.) ----
     if [ -n "$INTEROP_CMD" ] && [ ! -s "$INTEROP_JSON" ]; then
